@@ -1,0 +1,120 @@
+"""Benchmark: BASELINE.json ladder config 2 on real hardware.
+
+Runs the full meta-kriging pipeline (partition -> warm start -> K
+vmapped subset MCMCs -> combine -> resample -> predict) on a synthetic
+binary spatial field with n=10k, K=10, exponential covariance, and the
+reference's full MCMC budget (5000 iterations, 75% burn-in —
+MetaKriging_BinaryResponse.R:57-59,85).
+
+Prints ONE JSON line:
+  metric      — what was measured
+  value       — subset-fit wall-clock seconds (the reference's own
+                instrumented quantity, R:106-111)
+  unit        — "s"
+  vs_baseline — north-star headroom: 600 s (the BASELINE.json n=1M,
+                K=256, v5e-8 10-minute target) divided by this chip's
+                extrapolated share of that job. Extrapolation: per-chip
+                work scales by (subsets per chip) x (m'/m)^3 for the
+                per-iteration m x m Cholesky (SURVEY.md §2.3);
+                values > 1 mean the target is beaten.
+
+Synthetic latent surfaces use random Fourier features (an O(n)
+stationary GP approximation) so data generation never needs an n x n
+factorization.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_binary_field(key, n, q=1, p=2, phi=6.0, n_features=256):
+    """Probit binary field with an RFF-approximated exponential GP."""
+    kc, kw, kb, kcoef, kx, ky = jax.random.split(key, 6)
+    coords = jax.random.uniform(kc, (n, 2), jnp.float32)
+    # exponential covariance = Matern-1/2; its spectral density is a
+    # Cauchy — sample frequencies as phi * standard Cauchy
+    freqs = phi * jax.random.cauchy(kw, (n_features, 2), jnp.float32)
+    phase = jax.random.uniform(kb, (n_features,), jnp.float32, 0, 2 * np.pi)
+    coef = jax.random.normal(kcoef, (q, n_features), jnp.float32)
+    feats = jnp.sqrt(2.0 / n_features) * jnp.cos(coords @ freqs.T + phase)
+    w = feats @ coef.T  # (n, q)
+    x = jnp.concatenate(
+        [jnp.ones((n, q, 1), jnp.float32),
+         jax.random.normal(kx, (n, q, p - 1), jnp.float32)], -1
+    )
+    beta = jnp.asarray(np.linspace(0.8, -0.6, q * p).reshape(q, p), jnp.float32)
+    eta = jnp.einsum("nqp,qp->nq", x, beta) + w
+    y = (jax.random.uniform(ky, eta.shape) < jax.scipy.special.ndtr(eta)).astype(
+        jnp.float32
+    )
+    return y, x, coords
+
+
+def main():
+    from smk_tpu import SMKConfig, fit_meta_kriging
+    from smk_tpu.utils.diagnostics import effective_sample_size
+
+    n = int(os.environ.get("BENCH_N", 10_000))
+    k = int(os.environ.get("BENCH_K", 10))
+    n_samples = int(os.environ.get("BENCH_SAMPLES", 5000))
+    n_test = 64
+
+    key = jax.random.key(0)
+    y, x, coords = make_binary_field(key, n + n_test)
+    y, x, coords, coords_test, x_test = (
+        y[:n], x[:n], coords[:n], coords[n:], x[n:],
+    )
+
+    cfg = SMKConfig(n_subsets=k, n_samples=n_samples)
+    # Warm-up run with identical shapes populates the XLA compile
+    # cache so the reported wall-clock is pure execution (the scan
+    # program depends only on shapes/config, not data).
+    if os.environ.get("BENCH_WARMUP", "1") == "1":
+        fit_meta_kriging(
+            jax.random.key(1), y, x, coords, coords_test, x_test, config=cfg
+        )
+    t0 = time.time()
+    res = fit_meta_kriging(
+        jax.random.key(1), y, x, coords, coords_test, x_test, config=cfg
+    )
+    total = time.time() - t0
+    fit_s = res.phase_seconds["subset_fits"]
+
+    # latent-GP ESS/sec (the BASELINE.json companion metric): ESS of
+    # the kept predictive-latent draws, summed over subsets & columns.
+    ess = jax.vmap(effective_sample_size)(res.subset_results.w_samples)
+    ess_total = float(jnp.sum(ess))
+    ess_per_sec = ess_total / fit_s
+
+    # Extrapolate this chip's share of the n=1M, K=256, v5e-8 job:
+    # 32 subsets/chip at m*=3906 vs k subsets at m=n/k here; per-iter
+    # cost ~ subsets x m^3.
+    m = -(-n // k)
+    m_star, subsets_per_chip = 1_000_000 // 256, 256 // 8
+    scale = (subsets_per_chip / k) * (m_star / m) ** 3
+    extrapolated = fit_s * scale
+    vs_baseline = 600.0 / extrapolated
+
+    print(json.dumps({
+        "metric": f"SMK subset-fit wall-clock (n={n}, K={k}, "
+                  f"{n_samples} MCMC iters, exponential cov)",
+        "value": round(fit_s, 2),
+        "unit": "s",
+        "vs_baseline": round(vs_baseline, 3),
+        "total_pipeline_s": round(total, 2),
+        "latent_ess_per_sec": round(ess_per_sec, 1),
+        "extrapolated_1M_K256_v5e8_s": round(extrapolated, 1),
+        "phases": {kk: round(v, 2) for kk, v in res.phase_seconds.items()},
+    }))
+
+
+if __name__ == "__main__":
+    main()

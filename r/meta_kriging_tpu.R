@@ -1,0 +1,114 @@
+# R front-end for the TPU-native spatial meta-kriging framework.
+#
+# The reference workflow (MetaKriging_BinaryResponse.R) is an R script
+# whose inputs are free global variables (n, y.1, y.2, x.1, x.2,
+# coords, weight, coords.test, x.test, n.core — SURVEY.md §1.1). This
+# front-end keeps the R-facing contract but makes every input an
+# explicit argument and adds the `backend=` switch of the north star:
+# backend="tpu" (or "cpu") dispatches the heavy numerics — per-subset
+# Bayesian spatial probit GP MCMC, posterior combination, predictive
+# kriging — to the JAX framework via reticulate, while data assembly
+# and diagnostics stay in R.
+#
+# Usage:
+#   source("r/meta_kriging_tpu.R")
+#   fit <- meta_kriging_binary(
+#     y = list(y.1, y.2),          # K binary/binomial response vectors
+#     x = list(x.1, x.2),          # matching n x p design matrices
+#     coords = coords,             # n x 2 coordinates
+#     coords.test = coords.test,   # t x 2 prediction locations
+#     x.test = list(xt.1, xt.2),   # t x p prediction designs
+#     weight = 1,                  # binomial trial count
+#     n.core = 20,                 # K subsets (reference hardcoded 20)
+#     n.samples = 5000,            # MCMC budget (reference 100x50)
+#     backend = "tpu",
+#     combiner = "wasserstein_mean" # or "weiszfeld_median"
+#   )
+#
+# Returned list mirrors the reference script's outputs:
+#   $result      combined parameter quantile grid   (R:123-127)
+#   $result2     combined latent quantile grid      (R:129-133)
+#   $SamplePar   resampled parameter draws          (R:145)
+#   $Samplew     resampled latent draws             (R:146)
+#   $p.sample    predictive probability draws       (R:156-161)
+#   $param.quant / $w.quant / $p.quant  median + 95% CI (R:163-165)
+#   $phi.accept  per-subset MH acceptance (diagnostic)
+#   $phases      wall-clock per pipeline phase
+
+meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
+                                weight = 1, n.core = 20,
+                                n.samples = 5000, burn.in = 0.75,
+                                cov.model = "exponential",
+                                combiner = "wasserstein_mean",
+                                backend = c("tpu", "cpu"),
+                                seed = 0L,
+                                python_path = NULL) {
+  backend <- match.arg(backend)
+  if (!requireNamespace("reticulate", quietly = TRUE)) {
+    stop("the TPU backend needs the 'reticulate' package")
+  }
+  if (!is.null(python_path)) reticulate::use_python(python_path)
+
+  if (is.matrix(y) || is.numeric(y)) y <- list(y)
+  if (is.matrix(x)) x <- list(x)
+  if (is.matrix(x.test)) x.test <- list(x.test)
+  q <- length(y)
+  n <- length(y[[1]])
+  p <- ncol(x[[1]])
+
+  # stack to the framework's layouts: y (n, q); x (n, q, p);
+  # x.test (t, q, p)
+  y_arr <- sapply(y, as.numeric)                       # n x q
+  x_arr <- aperm(simplify2array(x), c(1, 3, 2))        # n x q x p
+  xt_arr <- aperm(simplify2array(x.test), c(1, 3, 2))  # t x q x p
+
+  jax <- reticulate::import("jax")
+  if (backend == "cpu") {
+    jax$config$update("jax_platforms", "cpu")
+  }
+  smk <- reticulate::import("smk_tpu")
+
+  cfg <- smk$SMKConfig(
+    n_subsets = as.integer(n.core),
+    n_samples = as.integer(n.samples),
+    burn_in_frac = burn.in,
+    cov_model = cov.model,
+    combiner = combiner
+  )
+  res <- smk$fit_meta_kriging(
+    jax$random$key(as.integer(seed)),
+    reticulate::np_array(y_arr, dtype = "float32"),
+    reticulate::np_array(x_arr, dtype = "float32"),
+    reticulate::np_array(coords, dtype = "float32"),
+    reticulate::np_array(coords.test, dtype = "float32"),
+    reticulate::np_array(xt_arr, dtype = "float32"),
+    config = cfg,
+    weight = as.integer(weight)
+  )
+
+  to_r <- function(a) reticulate::py_to_r(reticulate::import("numpy")$asarray(a))
+  list(
+    result = to_r(res$param_grid),
+    result2 = to_r(res$w_grid),
+    SamplePar = to_r(res$sample_par),
+    Samplew = to_r(res$sample_w),
+    p.sample = to_r(res$p_samples),
+    param.quant = to_r(res$param_quant),
+    w.quant = to_r(res$w_quant),
+    p.quant = to_r(res$p_quant),
+    phi.accept = to_r(res$phi_accept_rate),
+    phases = res$phase_seconds,
+    param.names = unlist(smk$api$param_names(as.integer(q), as.integer(p)))
+  )
+}
+
+# Traceplot diagnostics of the combined posterior, mirroring the
+# reference's plots (R:148-149): first parameter and first latent.
+plot_smk_traces <- function(fit) {
+  op <- par(mfrow = c(1, 2))
+  on.exit(par(op))
+  plot(fit$SamplePar[, 1], type = "l",
+       main = "combined posterior: parameter 1", ylab = fit$param.names[1])
+  plot(fit$Samplew[, 1], type = "l",
+       main = "combined posterior: latent 1", ylab = "w*[1]")
+}

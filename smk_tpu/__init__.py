@@ -1,0 +1,59 @@
+"""smk_tpu — TPU-native Spatial Meta-Kriging for binary responses.
+
+A brand-new JAX/XLA framework with the capabilities of the reference R
+workflow ``MetaKriging_BinaryResponse.R`` (spatial meta-kriging for
+distributed Bayesian inference on multivariate binary spatial data):
+
+- random disjoint partition of (y, X, coords) into K subsets
+  (reference: MetaKriging_BinaryResponse.R:15-41),
+- per-subset Bayesian multivariate binary spatial GP regression
+  (reference delegates to spBayes::spMvGLM, :80-84; here an
+  Albert–Chib probit Gibbs sampler written as a fused lax.scan),
+- embarrassingly parallel execution of the K fits (reference: PSOCK
+  cluster + foreach %dopar%, :100-114; here jax.vmap + shard_map over
+  a TPU device mesh),
+- posterior compression to quantile grids (:88-89) and combination by
+  quantile averaging — the 1-D Wasserstein-2 barycenter (:123-133) —
+  plus a Weiszfeld geometric-median combiner,
+- inverse-CDF resampling (:139-146) and predictive probability
+  p(y=1 | data) with credible intervals at new locations (:153-165).
+
+Everything on the compute path is pure JAX: static shapes, lax.scan
+MCMC, batched m×m Choleskys on the MXU, collectives over the mesh.
+"""
+
+from smk_tpu.config import SMKConfig, PriorConfig
+from smk_tpu.api import (
+    MetaKrigingResult,
+    fit_meta_kriging,
+    predict_probability,
+)
+from smk_tpu.parallel.partition import random_partition, Partition
+from smk_tpu.parallel.combine import (
+    wasserstein_barycenter,
+    weiszfeld_median,
+    combine_quantile_grids,
+)
+from smk_tpu.models.probit_gp import (
+    SpatialProbitGP,
+    SamplerState,
+    SubsetResult,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "SMKConfig",
+    "PriorConfig",
+    "MetaKrigingResult",
+    "fit_meta_kriging",
+    "predict_probability",
+    "random_partition",
+    "Partition",
+    "wasserstein_barycenter",
+    "weiszfeld_median",
+    "combine_quantile_grids",
+    "SpatialProbitGP",
+    "SamplerState",
+    "SubsetResult",
+]

@@ -1,0 +1,212 @@
+"""Top-level API: the reference workflow end-to-end as one call.
+
+``fit_meta_kriging`` is the explicit-argument version of the
+reference's implicit free-variable contract (SURVEY.md §1.1 — n, y.*,
+x.*, coords, weight, coords.test, x.test, n.core arrive as real
+arguments, not globals):
+
+    partition (R:15-41) -> GLM warm start (R:53-55, computed once and
+    broadcast per the §3.2 quirk) -> K-subset fits (R:80-96) run as a
+    vmap/sharded program (R:100-114) -> quantile-grid combination
+    (R:119-133) -> inverse-CDF resampling (R:136-146) -> predictive
+    p(y=1|data) and credible intervals (R:153-165).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from smk_tpu.config import SMKConfig
+from smk_tpu.models.probit_gp import SpatialProbitGP, SubsetResult, n_params
+from smk_tpu.ops.glm import glm_warm_start
+from smk_tpu.ops.quantiles import (
+    credible_summary,
+    interp_quantile_grid,
+    inverse_cdf_resample,
+)
+from smk_tpu.parallel.combine import combine_quantile_grids
+from smk_tpu.parallel.executor import fit_subsets_sharded, fit_subsets_vmap
+from smk_tpu.parallel.partition import random_partition
+from smk_tpu.utils.tracing import PhaseTimes, phase_timer
+
+
+class MetaKrigingResult(NamedTuple):
+    """Everything the reference script materializes, plus diagnostics.
+
+    param_grid / w_grid : combined (n_quantiles, d) grids — the
+        reference's `result` / `result2` (R:123-133).
+    sample_par / sample_w : resampled draws — `SamplePar` / `Samplew`
+        (R:145-146).
+    p_samples : predictive probability draws — `p.sample` (R:156-161).
+    param_quant / w_quant / p_quant : median + 95% CI — `param.quant`,
+        `w.quant` (R:163-165) and the same summary for p.
+    subset_results : per-subset compressed posteriors (the gathered
+        `obj` list, R:108) for checkpointing / shard re-runs.
+    phi_accept_rate : (K, q) MH acceptance per subset.
+    phase_seconds : structured wall-clock per phase (replaces
+        R:30,106,111).
+    """
+
+    param_grid: jnp.ndarray
+    w_grid: jnp.ndarray
+    sample_par: jnp.ndarray
+    sample_w: jnp.ndarray
+    p_samples: jnp.ndarray
+    param_quant: jnp.ndarray
+    w_quant: jnp.ndarray
+    p_quant: jnp.ndarray
+    subset_results: SubsetResult
+    phi_accept_rate: jnp.ndarray
+    phase_seconds: dict
+
+
+def param_names(q: int, p: int) -> list[str]:
+    """Column names of the parameter grid: beta by (response,
+    covariate), lower-tri of K = A A^T, phi — the spBayes
+    p.beta.theta.samples inventory (R:89)."""
+    names = [f"beta[{j},{r}]" for j in range(q) for r in range(p)]
+    names += [f"K[{i},{j}]" for i in range(q) for j in range(i + 1)]
+    names += [f"phi[{j}]" for j in range(q)]
+    return names
+
+
+def stacked_design(y: jnp.ndarray, x: jnp.ndarray):
+    """Stack (n, q) responses and (n, q, p) designs into the long GLM
+    layout the reference's warm start uses (R:53): response-major
+    blocks with a block-diagonal design."""
+    n, q, p = x.shape
+    y_long = y.T.reshape(-1)  # (q*n,)
+    x_long = jnp.zeros((q * n, q * p), x.dtype)
+    for j in range(q):
+        x_long = x_long.at[j * n : (j + 1) * n, j * p : (j + 1) * p].set(
+            x[:, j, :]
+        )
+    return y_long, x_long
+
+
+def _link_prob(eta: jnp.ndarray, link: str) -> jnp.ndarray:
+    if link == "probit":
+        return jax.scipy.special.ndtr(eta)
+    if link == "logit":
+        return 1.0 / (1.0 + jnp.exp(-eta))
+    raise ValueError(f"unknown link {link!r}")
+
+
+def predict_probability(
+    sample_par: jnp.ndarray,
+    sample_w: jnp.ndarray,
+    x_test: jnp.ndarray,
+    *,
+    link: str = "probit",
+) -> jnp.ndarray:
+    """p(y=1 | data) per combined posterior draw — R:153-161.
+
+    Generalizes the reference's hardcoded `SamplePar[j,1:4]` beta
+    slice (R:159, pinned to q=2, p=2) to any (q, p): the first q*p
+    parameter columns are the stacked betas. sample_w columns are
+    response-fastest over test sites, matching the sampler's
+    predictive layout.
+    """
+    t, q, p = x_test.shape
+    betas = sample_par[:, : q * p].reshape(-1, q, p)  # (S, q, p)
+    eta_fixed = jnp.einsum("tqp,sqp->stq", x_test, betas)  # (S, t, q)
+    eta = eta_fixed.reshape(sample_par.shape[0], -1) + sample_w
+    return _link_prob(eta, link)
+
+
+def fit_meta_kriging(
+    key: jax.Array,
+    y: jnp.ndarray,
+    x: jnp.ndarray,
+    coords: jnp.ndarray,
+    coords_test: jnp.ndarray,
+    x_test: jnp.ndarray,
+    *,
+    config: Optional[SMKConfig] = None,
+    weight: int = 1,
+    sharded: bool = False,
+    mesh=None,
+    chunk_size: Optional[int] = None,
+) -> MetaKrigingResult:
+    """Full spatial-meta-kriging pipeline.
+
+    y: (n, q) binary/binomial counts; x: (n, q, p) designs;
+    coords: (n, d); coords_test: (t, d); x_test: (t, q, p);
+    weight: binomial trial count (reference `weight`, R:53,81).
+    """
+    cfg = config or SMKConfig()
+    if cfg.link != "probit":
+        raise NotImplementedError(
+            "the TPU-native sampler is Albert–Chib probit (north star); "
+            "logit-link sampling is not yet implemented — use "
+            "link='probit'"
+        )
+    times = PhaseTimes()
+    k_part, k_fit, k_resample = jax.random.split(key, 3)
+
+    with phase_timer(times, "partition"):
+        part = random_partition(k_part, y, x, coords, cfg.n_subsets)
+        jax.block_until_ready(part.y)
+
+    with phase_timer(times, "warm_start"):
+        y_long, x_long = stacked_design(y, x)
+        fit = glm_warm_start(y_long, x_long, weight=weight, link=cfg.link)
+        q, p = x.shape[1], x.shape[2]
+        beta_init = fit.coef.reshape(q, p)
+        jax.block_until_ready(beta_init)
+
+    model = SpatialProbitGP(cfg, weight=weight)
+    with phase_timer(times, "subset_fits"):
+        if sharded:
+            results = fit_subsets_sharded(
+                model, part, coords_test, x_test, k_fit, beta_init,
+                mesh=mesh, chunk_size=chunk_size,
+            )
+        else:
+            results = fit_subsets_vmap(
+                model, part, coords_test, x_test, k_fit, beta_init,
+                chunk_size=chunk_size,
+            )
+        jax.block_until_ready(results.param_grid)
+
+    with phase_timer(times, "combine"):
+        param_grid = combine_quantile_grids(
+            results.param_grid, cfg.combiner,
+            n_iter=cfg.weiszfeld_iters, eps=cfg.weiszfeld_eps,
+        )
+        w_grid = combine_quantile_grids(
+            results.w_grid, cfg.combiner,
+            n_iter=cfg.weiszfeld_iters, eps=cfg.weiszfeld_eps,
+        )
+        jax.block_until_ready(param_grid)
+
+    with phase_timer(times, "resample_predict"):
+        dense_par = interp_quantile_grid(param_grid, cfg.interp_grid_step)
+        dense_w = interp_quantile_grid(w_grid, cfg.interp_grid_step)
+        sample_par, sample_w = inverse_cdf_resample(
+            k_resample, [dense_par, dense_w], cfg.resample_size
+        )
+        p_samples = predict_probability(
+            sample_par, sample_w, x_test, link=cfg.link
+        )
+        param_quant = credible_summary(sample_par)
+        w_quant = credible_summary(sample_w)
+        p_quant = credible_summary(p_samples)
+        jax.block_until_ready(p_quant)
+
+    return MetaKrigingResult(
+        param_grid=param_grid,
+        w_grid=w_grid,
+        sample_par=sample_par,
+        sample_w=sample_w,
+        p_samples=p_samples,
+        param_quant=param_quant,
+        w_quant=w_quant,
+        p_quant=p_quant,
+        subset_results=results,
+        phi_accept_rate=results.phi_accept_rate,
+        phase_seconds=times.as_dict(),
+    )

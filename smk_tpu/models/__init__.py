@@ -1,0 +1,12 @@
+"""Model layer: per-subset Bayesian spatial GP samplers (the
+replacement for spBayes::spMvGLM / spPredict — reference L1/L3 layers,
+SURVEY.md §1)."""
+
+from smk_tpu.models.probit_gp import (
+    SpatialProbitGP,
+    SubsetData,
+    SamplerState,
+    SubsetResult,
+)
+
+__all__ = ["SpatialProbitGP", "SubsetData", "SamplerState", "SubsetResult"]

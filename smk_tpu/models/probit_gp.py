@@ -1,0 +1,385 @@
+"""Multivariate binary spatial GP regression — the per-subset model.
+
+TPU-native replacement for the reference's workhorse,
+``spBayes::spMvGLM`` + ``spPredict`` (MetaKriging_BinaryResponse.R:80-87
+and the ~2,500 LoC of C++ behind them, SURVEY.md §2.3). The reference
+fits a logit-link multivariate GLM with a linear-model-of-
+coregionalization (LMC) latent GP by adaptive Metropolis-within-Gibbs,
+redoing a dense (q·m)×(q·m) Cholesky every iteration.
+
+The TPU-first redesign (NOT a translation):
+
+- **Probit link + Albert–Chib latents** (the BASELINE.json north
+  star): each binary observation gets z ~ N(eta, 1) truncated by y,
+  making every other update conjugate — no per-block MH tuning, no
+  Roberts–Rosenthal adaptation (R:83), fully static control flow.
+- **Component-GP factorization of the LMC**: the latent surface is
+  w = U A^T with U's q columns independent unit-variance GPs and A
+  lower-triangular (cross-covariance K = A A^T at distance zero —
+  exactly the spBayes "K.IW" parametrization, R:64). Gibbs runs on
+  the q components separately, so the hot kernel is q batched m×m
+  Choleskys per iteration — O(q m^3) on the MXU — instead of the
+  reference's single O(q^3 m^3) factorization.
+- **One fused lax.scan** over MCMC iterations: no host sync, no
+  per-iteration dispatch; two scans (burn-in without outputs, then
+  sampling collecting parameter draws and predictive latent draws)
+  keep memory at kept-draws size only.
+- **Masked padding** for ragged subsets (the reference's unequal last
+  subset, R:17-18): padded rows get ~infinite observation noise, so
+  their latents revert to the prior and contribute nothing.
+
+Updates per iteration:
+  1. z    — truncated-normal Albert–Chib latents (binomial `weight`
+            trials supported, matching the weights matrix at R:81).
+  2. beta — conjugate Gaussian per response (flat prior, R:63).
+  3. phi  — random-walk MH on a logit-transformed Unif(lo, hi) support
+            per component (prior bounds from R:63).
+  4. U    — per-component Gaussian conditional drawn exactly by
+            Matheron's rule: u' = u* + R (R + D)^{-1} (ytilde - u* - eta*),
+            needing only chol(R) (reused from the phi step) and
+            chol(R + D).
+  5. A    — conjugate Gaussian rows (lower-triangular), replacing the
+            reference's random-walk MH on A (R:61-64).
+  6. prediction — exact conditional kriging draw of the latent at the
+            test sites per kept iteration (composition sampling, the
+            spPredict equivalent, R:85-87).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from smk_tpu.config import SMKConfig
+from smk_tpu.ops.chol import (
+    chol_logdet,
+    chol_solve,
+    jittered_cholesky,
+    tri_solve,
+)
+from smk_tpu.ops.distance import cross_distance, pairwise_distance
+from smk_tpu.ops.kernels import correlation
+from smk_tpu.ops.quantiles import quantile_grid
+from smk_tpu.ops.truncnorm import sample_albert_chib_latent
+
+
+class SubsetData(NamedTuple):
+    """One subset's (padded) data slice.
+
+    coords: (m, d) observed locations
+    x:      (m, q, p) per-response design rows (reference x.1/x.2
+            slices, R:36-37, stacked on a response axis)
+    y:      (m, q) success counts in [0, weight]
+    mask:   (m,) 1.0 for real rows, 0.0 for padding
+    coords_test: (t, d) prediction locations  (R:87 coords.test)
+    x_test: (t, q, p) prediction design       (R:87,160 x.test)
+    """
+
+    coords: jnp.ndarray
+    x: jnp.ndarray
+    y: jnp.ndarray
+    mask: jnp.ndarray
+    coords_test: jnp.ndarray
+    x_test: jnp.ndarray
+
+
+class SamplerState(NamedTuple):
+    """Carry of the MCMC scan — a pure pytree (checkpointable)."""
+
+    beta: jnp.ndarray  # (q, p)
+    u: jnp.ndarray  # (m, q) component GPs
+    a: jnp.ndarray  # (q, q) lower-triangular coregionalization
+    phi: jnp.ndarray  # (q,)
+    chol_r: jnp.ndarray  # (q, m, m) Cholesky of R(phi) — carried so the
+    # phi-MH step factors only the proposal, not the current state
+    key: jax.Array
+    phi_accept: jnp.ndarray  # (q,) running acceptance count
+
+
+class SubsetResult(NamedTuple):
+    """What a subset ships home — mirrors the reference's compressed
+    return value `list(parameters=..., w.predict=...)` (R:89,95)."""
+
+    param_grid: jnp.ndarray  # (n_quantiles, n_params)
+    w_grid: jnp.ndarray  # (n_quantiles, t*q)
+    phi_accept_rate: jnp.ndarray  # (q,)
+    param_samples: jnp.ndarray  # (n_kept, n_params) raw kept draws
+    w_samples: jnp.ndarray  # (n_kept, t*q) raw kept predictive draws
+
+
+def n_params(q: int, p: int) -> int:
+    """beta (q*p) + lower-tri of K = A A^T (q(q+1)/2) + phi (q) —
+    the spBayes p.beta.theta.samples parameter inventory (R:89)."""
+    return q * p + q * (q + 1) // 2 + q
+
+
+class SpatialProbitGP:
+    """Single-subset sampler. All config is static; `run` is jit/vmap
+    friendly (pure function of (data, init_state))."""
+
+    def __init__(self, config: SMKConfig, *, weight: int = 1):
+        self.config = config
+        self.weight = int(weight)
+
+    # ------------------------------------------------------------------
+    # Initialization
+    # ------------------------------------------------------------------
+    def init_state(
+        self,
+        key: jax.Array,
+        data: SubsetData,
+        beta_init: Optional[jnp.ndarray] = None,
+    ) -> SamplerState:
+        """Starting values mirroring the reference (R:56-60): beta from
+        the GLM warm start (passed in; computed once and broadcast per
+        SURVEY.md §3.2), phi = 3/0.5, A = I lower-tri, w = 0."""
+        m, q, p = data.x.shape
+        dtype = data.x.dtype
+        if beta_init is None:
+            beta_init = jnp.zeros((q, p), dtype)
+        phi0 = jnp.full((q,), 3.0 / 0.5, dtype)
+        lo, hi = self.config.priors.phi_min, self.config.priors.phi_max
+        phi0 = jnp.clip(phi0, lo + 1e-3 * (hi - lo), hi - 1e-3 * (hi - lo))
+        dist = pairwise_distance(data.coords)
+        r0 = correlation(dist[None], phi0[:, None, None], self.config.cov_model)
+        return SamplerState(
+            beta=beta_init.astype(dtype),
+            u=jnp.zeros((m, q), dtype),
+            a=jnp.eye(q, dtype=dtype),
+            phi=phi0,
+            chol_r=jittered_cholesky(r0, self.config.jitter),
+            key=key,
+            phi_accept=jnp.zeros((q,), dtype),
+        )
+
+    # ------------------------------------------------------------------
+    # One Gibbs iteration
+    # ------------------------------------------------------------------
+    def _gibbs_step(self, data, consts, state, *, collect: bool):
+        cfg = self.config
+        weight = self.weight
+        m, q, p = data.x.shape
+        dtype = data.x.dtype
+        dist, chol_g, dist_cross, dist_test = consts
+        mask = data.mask
+
+        key, kz, kb, kphi, kprop, ku_prior, ku_noise, ka, kpred = jax.random.split(
+            state.key, 9
+        )
+
+        beta, u, a, phi = state.beta, state.u, state.a, state.phi
+
+        # --- 1. Albert–Chib latent update -----------------------------
+        eta_fixed = jnp.einsum("mqp,qp->mq", data.x, beta)
+        w = u @ a.T  # (m, q)
+        mu = eta_fixed + w
+        zbar = sample_albert_chib_latent(kz, mu, data.y, weight)
+
+        # --- 2. beta | z, w (conjugate, flat prior) -------------------
+        resid_b = (zbar - w) * mask[:, None]  # (m, q)
+        rhs = jnp.einsum("mqp,mq->qp", data.x, resid_b)  # X_j^T M r_j
+        mean_b = jax.vmap(chol_solve)(chol_g, rhs)  # (q, p)
+        noise = jax.vmap(lambda L, e: tri_solve(L, e, trans=True))(
+            chol_g, jax.random.normal(kb, (q, p), dtype)
+        )
+        beta = mean_b + noise / jnp.sqrt(jnp.asarray(float(weight), dtype))
+        eta_fixed = jnp.einsum("mqp,qp->mq", data.x, beta)
+
+        # --- 3. phi | u (logit-RW MH on Unif support) -----------------
+        lo = jnp.asarray(cfg.priors.phi_min, dtype)
+        hi = jnp.asarray(cfg.priors.phi_max, dtype)
+
+        def u_loglik(chol_r):
+            # (q, m, m) stacked factors vs (m, q) components
+            alpha = jax.vmap(tri_solve)(chol_r, u.T[..., None])[..., 0]
+            return -0.5 * jnp.sum(alpha * alpha, axis=-1) - 0.5 * chol_logdet(
+                chol_r
+            )
+
+        def chol_of(phis):
+            r = correlation(dist[None], phis[:, None, None], cfg.cov_model)
+            return jittered_cholesky(r, cfg.jitter)
+
+        t_cur = jnp.log((phi - lo) / (hi - phi))
+        t_prop = t_cur + cfg.phi_step * jax.random.normal(kprop, (q,), dtype)
+        sig_cur = jax.nn.sigmoid(t_cur)
+        sig_prop = jax.nn.sigmoid(t_prop)
+        phi_prop = lo + (hi - lo) * sig_prop
+        log_jac_cur = jnp.log(sig_cur * (1.0 - sig_cur))
+        log_jac_prop = jnp.log(sig_prop * (1.0 - sig_prop))
+
+        chol_cur = state.chol_r  # factored when phi was last accepted
+        chol_prop = chol_of(phi_prop)
+        log_ratio = (
+            u_loglik(chol_prop)
+            + log_jac_prop
+            - u_loglik(chol_cur)
+            - log_jac_cur
+        )
+        accept = jnp.log(
+            jax.random.uniform(kphi, (q,), dtype, minval=1e-12)
+        ) < log_ratio
+        phi = jnp.where(accept, phi_prop, phi)
+        chol_r = jnp.where(accept[:, None, None], chol_prop, chol_cur)
+        phi_accept = state.phi_accept + accept.astype(dtype)
+
+        # --- 4. U | z, beta, A, phi — per-component Matheron draw -----
+        ata_diag = jnp.sum(a * a, axis=0)  # (q,) (A^T A)_jj
+        e0 = zbar - eta_fixed  # (m, q)
+        big = jnp.asarray(cfg.mask_noise_var, dtype)
+        ku_priors = jax.random.split(ku_prior, q)
+        ku_noises = jax.random.split(ku_noise, q)
+        for j in range(q):
+            a_j = a[:, j]  # (q,)
+            c_scale = jnp.maximum(ata_diag[j], 1e-12)
+            # residual excluding component j's contribution
+            w_full = u @ a.T
+            partial = e0 - w_full + jnp.outer(u[:, j], a_j)
+            ytilde = (partial @ a_j) / c_scale  # (m,)
+            d_vec = jnp.where(
+                mask > 0, 1.0 / (weight * c_scale), big
+            )  # (m,) noise variance of the pseudo-obs
+            l_j = chol_r[j]
+            # prior draw u* = L xi  and noise draw eta* = sqrt(d) xi2
+            u_star = l_j @ jax.random.normal(ku_priors[j], (m,), dtype)
+            eta_star = jnp.sqrt(d_vec) * jax.random.normal(
+                ku_noises[j], (m,), dtype
+            )
+            # R rebuilt elementwise from the distance matrix — O(m^2),
+            # not the O(m^3) matmul L @ L^T (same matrix up to jitter)
+            r_mat = correlation(dist, phi[j], cfg.cov_model) + cfg.jitter * jnp.eye(
+                m, dtype=dtype
+            )
+            chol_m = jittered_cholesky(
+                r_mat + jnp.diag(d_vec), cfg.jitter
+            )
+            s = chol_solve(chol_m, ytilde - u_star - eta_star)
+            u = u.at[:, j].set(u_star + r_mat @ s)
+
+        # --- 5. A | z, beta, U (conjugate rows, lower-triangular) -----
+        mu_mask = mask[:, None] * u  # masked design (m, q)
+        s_mat = weight * (u.T @ mu_mask)  # (q, q) shared Gram
+        t_mat = weight * (mu_mask.T @ e0)  # (q, q); column l is rhs for row l
+        prior_prec = 1.0 / jnp.asarray(cfg.priors.a_scale, dtype) ** 2
+        row_idx = jnp.arange(q)
+        # entries k > l are pinned to ~0 by a huge prior precision —
+        # one batched (q, q) solve replaces a ragged per-row loop
+        pin = jnp.where(row_idx[None, :] <= row_idx[:, None], prior_prec, 1e12)
+
+        def draw_row(rhs_l, pin_l, key_l):
+            p_l = s_mat + jnp.diag(pin_l)
+            chol_p = jittered_cholesky(p_l, cfg.jitter)
+            mean_l = chol_solve(chol_p, rhs_l)
+            z = jax.random.normal(key_l, (q,), dtype)
+            return mean_l + tri_solve(chol_p, z, trans=True)
+
+        a_rows = jax.vmap(draw_row)(t_mat.T, pin, jax.random.split(ka, q))
+        a = jnp.tril(a_rows)
+
+        new_state = SamplerState(
+            beta=beta, u=u, a=a, phi=phi, chol_r=chol_r, key=key,
+            phi_accept=phi_accept,
+        )
+        if not collect:
+            return new_state, None
+
+        # --- 6. predictive kriging draw (spPredict equivalent) --------
+        t_test = data.coords_test.shape[0]
+        r_cross = correlation(
+            dist_cross[None], phi[:, None, None], cfg.cov_model
+        )  # (q, m, t)
+        r_test = correlation(
+            dist_test[None], phi[:, None, None], cfg.cov_model
+        )  # (q, t, t)
+
+        def krige(l_j, rc_j, rt_j, u_j, key_j):
+            v = tri_solve(l_j, rc_j)  # (m, t)
+            alpha = tri_solve(l_j, u_j)  # (m,)
+            cond_mean = v.T @ alpha
+            cond_cov = rt_j - v.T @ v
+            chol_c = jittered_cholesky(cond_cov, cfg.jitter)
+            z = jax.random.normal(key_j, (t_test,), dtype)
+            return cond_mean + chol_c @ z
+
+        u_star_test = jax.vmap(krige)(
+            chol_r, r_cross, r_test, u.T, jax.random.split(kpred, q)
+        )  # (q, t)
+        w_star = (u_star_test.T @ a.T).reshape(-1)  # (t*q,) response-fastest
+
+        # parameter vector: beta, lower-tri(K = A A^T), phi — the
+        # p.beta.theta.samples inventory (R:89)
+        k_mat = a @ a.T
+        tril_r, tril_c = jnp.tril_indices(q)
+        params = jnp.concatenate(
+            [beta.reshape(-1), k_mat[tril_r, tril_c], phi]
+        )
+        return new_state, (params, w_star)
+
+    # ------------------------------------------------------------------
+    # Full run
+    # ------------------------------------------------------------------
+    def run(
+        self,
+        data: SubsetData,
+        init_state: SamplerState,
+    ) -> SubsetResult:
+        """Burn-in scan + sampling scan + on-device compression.
+
+        Pure function of (data, init_state): vmap it over a stacked K
+        axis for the meta-kriging fan-out, or shard_map it over the
+        device mesh (parallel/executor.py).
+
+        The whole trace runs under matmul precision HIGHEST: the
+        m-contraction products feed correlation Choleskys and Gaussian
+        conditionals where TPU default bf16 passes are not enough (the
+        reference's backend used fp64 BLAS; full-rate fp32 is the
+        floor for statistical fidelity).
+        """
+        with jax.default_matmul_precision("highest"):
+            return self._run(data, init_state)
+
+    def _run(self, data, init_state):
+        cfg = self.config
+        dtype = data.x.dtype
+
+        # Per-subset constants, built once and closed over by the scan
+        # body (distances never change; only the phi decay does).
+        dist = pairwise_distance(data.coords)
+        dist_cross = cross_distance(data.coords, data.coords_test)
+        dist_test = pairwise_distance(data.coords_test)
+        # Gram matrices X_j^T M X_j for the conjugate beta update.
+        xm = data.x * data.mask[:, None, None]
+        gram = jnp.einsum("mqp,mqr->qpr", xm, data.x)
+        chol_g = jittered_cholesky(gram, 1e-6)
+        consts = (dist, chol_g, dist_cross, dist_test)
+
+        burn_step = lambda st, _: (
+            self._gibbs_step(data, consts, st, collect=False)[0],
+            None,
+        )
+        keep_step = lambda st, _: self._gibbs_step(
+            data, consts, st, collect=True
+        )
+
+        state, _ = lax.scan(
+            burn_step, init_state, None, length=cfg.n_burn_in
+        )
+        # reset acceptance counter so the reported rate is post-burn-in
+        state = state._replace(phi_accept=jnp.zeros_like(state.phi_accept))
+        state, (param_draws, w_draws) = lax.scan(
+            keep_step, state, None, length=cfg.n_kept
+        )
+
+        param_grid = quantile_grid(param_draws, cfg.n_quantiles)
+        w_grid = quantile_grid(w_draws, cfg.n_quantiles)
+        return SubsetResult(
+            param_grid=param_grid,
+            w_grid=w_grid,
+            phi_accept_rate=state.phi_accept / float(cfg.n_kept),
+            param_samples=param_draws,
+            w_samples=w_draws,
+        )

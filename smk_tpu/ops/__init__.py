@@ -1,0 +1,38 @@
+"""Core numerics: the TPU-native replacement for the spBayes C++
+backend (reference L1 layer — SURVEY.md §1, §2.3)."""
+
+from smk_tpu.ops.distance import pairwise_distance, cross_distance
+from smk_tpu.ops.kernels import correlation, CORRELATION_FNS
+from smk_tpu.ops.chol import (
+    jittered_cholesky,
+    chol_solve,
+    chol_logdet,
+    tri_solve,
+)
+from smk_tpu.ops.truncnorm import truncated_normal, sample_albert_chib_latent
+from smk_tpu.ops.glm import irls_glm, glm_warm_start
+from smk_tpu.ops.quantiles import (
+    quantile_grid,
+    interp_quantile_grid,
+    inverse_cdf_resample,
+    credible_summary,
+)
+
+__all__ = [
+    "pairwise_distance",
+    "cross_distance",
+    "correlation",
+    "CORRELATION_FNS",
+    "jittered_cholesky",
+    "chol_solve",
+    "chol_logdet",
+    "tri_solve",
+    "truncated_normal",
+    "sample_albert_chib_latent",
+    "irls_glm",
+    "glm_warm_start",
+    "quantile_grid",
+    "interp_quantile_grid",
+    "inverse_cdf_resample",
+    "credible_summary",
+]

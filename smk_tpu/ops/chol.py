@@ -1,0 +1,44 @@
+"""Cholesky factorization and solves with jitter.
+
+These wrap lax.linalg so the per-iteration dense factorizations — the
+hot kernel of the whole system (SURVEY.md §2.3: spBayes does a dense
+(q·m)×(q·m) dpotrf every MCMC iteration, called from
+MetaKriging_BinaryResponse.R:80-84) — are batched m×m factorizations
+on the MXU under vmap. fp32 needs a diagonal jitter for conditioning;
+the jitter is added once here so every call site is consistent.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+from jax.scipy.linalg import solve_triangular
+
+
+def jittered_cholesky(mat: jnp.ndarray, jitter: float = 1e-5) -> jnp.ndarray:
+    """Lower Cholesky factor of ``mat + jitter * I``.
+
+    Works on (..., m, m) batches; XLA lowers batched cholesky to
+    MXU-tiled kernels.
+    """
+    m = mat.shape[-1]
+    eye = jnp.eye(m, dtype=mat.dtype)
+    # lax.linalg.cholesky may leave garbage above the diagonal on some
+    # backends; zero it so L is usable in plain matmuls (L @ L.T).
+    return jnp.tril(lax.linalg.cholesky(mat + jitter * eye))
+
+
+def tri_solve(chol_l: jnp.ndarray, b: jnp.ndarray, *, trans: bool = False) -> jnp.ndarray:
+    """Solve L x = b (or L^T x = b when trans) for lower-triangular L."""
+    return solve_triangular(chol_l, b, lower=True, trans=1 if trans else 0)
+
+
+def chol_solve(chol_l: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Solve (L L^T) x = b given the lower factor L."""
+    return tri_solve(chol_l, tri_solve(chol_l, b), trans=True)
+
+
+def chol_logdet(chol_l: jnp.ndarray) -> jnp.ndarray:
+    """log det(L L^T) = 2 * sum(log diag(L)); batched over leading dims."""
+    diag = jnp.diagonal(chol_l, axis1=-2, axis2=-1)
+    return 2.0 * jnp.sum(jnp.log(diag), axis=-1)

@@ -1,0 +1,113 @@
+"""Non-spatial binomial GLM fit by IRLS — the warm start.
+
+Replaces the reference's ``glm((y/weight)~x-1, weights=rep(weight,n*q),
+family="binomial")`` warm start (MetaKriging_BinaryResponse.R:53-55),
+which supplies MCMC starting values (coefficients) and, in the
+reference, the beta MH proposal covariance (chol(vcov)). The TPU
+sampler's beta update is conjugate so only the starting values are
+load-bearing, but vcov is still returned for parity and diagnostics.
+
+A fixed-iteration Newton/IRLS loop (lax.fori_loop, static trip count)
+keeps everything jit/vmap-friendly: no data-dependent convergence
+branching, static shapes, one small Cholesky solve per step.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+from jax import lax
+from jax.scipy.special import ndtr
+
+from smk_tpu.ops.chol import jittered_cholesky, chol_solve
+
+
+class GLMFit(NamedTuple):
+    coef: jnp.ndarray  # (p,)
+    vcov: jnp.ndarray  # (p, p) inverse Fisher information at the MLE
+    converged_delta: jnp.ndarray  # scalar: last Newton-step max |delta|
+
+
+def _link_quantities(eta: jnp.ndarray, link: str):
+    """Return (p, dp/deta) for the given link, clipped for stability."""
+    if link == "logit":
+        p = 1.0 / (1.0 + jnp.exp(-eta))
+        dmu = p * (1.0 - p)
+    elif link == "probit":
+        p = ndtr(eta)
+        dmu = jnp.exp(-0.5 * eta * eta) / jnp.sqrt(2.0 * jnp.pi).astype(eta.dtype)
+    else:
+        raise ValueError(f"unknown link {link!r}")
+    p = jnp.clip(p, 1e-6, 1.0 - 1e-6)
+    dmu = jnp.maximum(dmu, 1e-8)
+    return p, dmu
+
+
+def irls_glm(
+    y: jnp.ndarray,
+    x: jnp.ndarray,
+    *,
+    weight: float = 1.0,
+    link: str = "logit",
+    n_iter: int = 25,
+    obs_mask: jnp.ndarray | None = None,
+    ridge: float = 1e-6,
+) -> GLMFit:
+    """Binomial GLM MLE of y/weight on x (no intercept column added).
+
+    y: (n,) success counts in [0, weight]; x: (n, p) design;
+    obs_mask: optional (n,) {0,1} mask for padded rows (SURVEY.md §7
+    "ragged subsets" — padded observations contribute zero weight).
+    """
+    n, p_dim = x.shape
+    dtype = x.dtype
+    ybar = (y / weight).astype(dtype)
+    mask = jnp.ones((n,), dtype) if obs_mask is None else obs_mask.astype(dtype)
+
+    def step(_, beta):
+        eta = x @ beta
+        mu, dmu = _link_quantities(eta, link)
+        var = mu * (1.0 - mu)
+        w_work = mask * weight * dmu * dmu / var
+        z_work = eta + (ybar - mu) / dmu
+        xtw = x.T * w_work[None, :]
+        hess = xtw @ x
+        chol_h = jittered_cholesky(hess, ridge)
+        new_beta = chol_solve(chol_h, xtw @ z_work)
+        return new_beta
+
+    beta0 = jnp.zeros((p_dim,), dtype)
+    beta = lax.fori_loop(0, n_iter, step, beta0)
+    # One extra evaluation for vcov and the convergence delta.
+    beta_next = step(0, beta)
+    eta = x @ beta_next
+    mu, dmu = _link_quantities(eta, link)
+    var = mu * (1.0 - mu)
+    w_work = mask * weight * dmu * dmu / var
+    hess = (x.T * w_work[None, :]) @ x
+    chol_h = jittered_cholesky(hess, ridge)
+    vcov = chol_solve(chol_h, jnp.eye(p_dim, dtype=dtype))
+    delta = jnp.max(jnp.abs(beta_next - beta))
+    return GLMFit(coef=beta_next, vcov=vcov, converged_delta=delta)
+
+
+def glm_warm_start(
+    y_stacked: jnp.ndarray,
+    x_stacked: jnp.ndarray,
+    *,
+    weight: float = 1.0,
+    link: str = "probit",
+    obs_mask: jnp.ndarray | None = None,
+) -> GLMFit:
+    """Warm start on the stacked multivariate design.
+
+    The reference stacks the q responses/designs into one long GLM
+    (R:53 uses the full-data y, x — see SURVEY.md §3.2 quirk: the warm
+    start is intentionally computable once and broadcast). Here the
+    caller passes the stacked (n_total,) response and block-diagonal
+    (n_total, p_total) design; the result seeds every subset chain.
+    """
+    return irls_glm(
+        y_stacked, x_stacked, weight=weight, link=link, obs_mask=obs_mask
+    )

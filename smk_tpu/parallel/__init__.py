@@ -1,0 +1,26 @@
+"""Parallel layer: partitioner (reference L2), fan-out executor
+(reference L4 — PSOCK cluster + foreach, here vmap/shard_map over a
+device mesh) and posterior combiners (reference L5)."""
+
+from smk_tpu.parallel.partition import random_partition, Partition
+from smk_tpu.parallel.executor import (
+    fit_subsets_vmap,
+    fit_subsets_sharded,
+    make_mesh,
+)
+from smk_tpu.parallel.combine import (
+    wasserstein_barycenter,
+    weiszfeld_median,
+    combine_quantile_grids,
+)
+
+__all__ = [
+    "random_partition",
+    "Partition",
+    "fit_subsets_vmap",
+    "fit_subsets_sharded",
+    "make_mesh",
+    "wasserstein_barycenter",
+    "weiszfeld_median",
+    "combine_quantile_grids",
+]

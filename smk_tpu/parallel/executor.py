@@ -1,0 +1,164 @@
+"""Parallel fan-out executor — reference layer L4, rebuilt for TPU.
+
+The reference ships each subset to one of K=20 PSOCK worker processes
+over localhost sockets and gathers a list
+(MetaKriging_BinaryResponse.R:100-114). Here the K subsets are one
+stacked array axis:
+
+- ``fit_subsets_vmap``: jax.vmap of the whole sampler over K — every
+  subset's MCMC advances in lockstep inside a single fused XLA
+  program; zero communication during the fit (the share-nothing SMK
+  property, SURVEY.md §2.2) so the vmap axis is embarrassingly
+  partitionable.
+- ``fit_subsets_sharded``: the same program with the K axis laid out
+  over a ``jax.sharding.Mesh`` — each device runs its K/n_devices
+  subsets; XLA inserts no collectives until the combiner's reduction,
+  which rides ICI. An optional ``chunk_size`` scans device-local
+  subsets in memory-sized chunks (lax.map) so K per device can exceed
+  what fits in HBM at once.
+
+There are no host sockets or per-subset dispatch anywhere in the hot
+path — the reference's process boundary (SURVEY.md §3.2) becomes an
+array axis.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from smk_tpu.models.probit_gp import SpatialProbitGP, SubsetData, SubsetResult
+from smk_tpu.parallel.partition import Partition
+
+# vmap axes for SubsetData: subset-local fields batch on axis 0, test
+# locations are shared across subsets (broadcast), matching the
+# reference where every worker predicts at the same coords.test (R:87).
+_DATA_AXES = SubsetData(coords=0, x=0, y=0, mask=0, coords_test=None, x_test=None)
+
+
+def _stacked_data(
+    part: Partition, coords_test: jnp.ndarray, x_test: jnp.ndarray
+) -> SubsetData:
+    return SubsetData(
+        coords=part.coords,
+        x=part.x,
+        y=part.y,
+        mask=part.mask,
+        coords_test=coords_test,
+        x_test=x_test,
+    )
+
+
+def fit_subsets_vmap(
+    model: SpatialProbitGP,
+    part: Partition,
+    coords_test: jnp.ndarray,
+    x_test: jnp.ndarray,
+    key: jax.Array,
+    beta_init: Optional[jnp.ndarray] = None,
+    *,
+    chunk_size: Optional[int] = None,
+) -> SubsetResult:
+    """Run all K subset samplers as one vmapped program.
+
+    Each subset gets its own PRNG key (the reference gives each worker
+    an independent — but unseeded — stream; here streams are split
+    deterministically). ``chunk_size`` optionally scans the K axis in
+    chunks of that size to bound peak memory.
+    """
+    k = part.n_subsets
+    data = _stacked_data(part, coords_test, x_test)
+    keys = jax.random.split(key, k)
+    init = jax.vmap(lambda kk, d: model.init_state(kk, d, beta_init), in_axes=(0, _DATA_AXES))(
+        keys, data
+    )
+
+    runner = jax.vmap(model.run, in_axes=(_DATA_AXES, 0))
+    if chunk_size is None or chunk_size >= k:
+        return runner(data, init)
+
+    if k % chunk_size != 0:
+        raise ValueError(f"chunk_size {chunk_size} must divide K={k}")
+    n_chunks = k // chunk_size
+
+    def to_chunks(a):
+        return a.reshape((n_chunks, chunk_size) + a.shape[1:])
+
+    # batched subset-local fields get a chunk axis; the shared test
+    # fields are closed over (they broadcast across subsets)
+    batched = SubsetData(
+        coords=data.coords, x=data.x, y=data.y, mask=data.mask,
+        coords_test=None, x_test=None,
+    )
+    chunk_args = jax.tree_util.tree_map(to_chunks, (batched, init))
+
+    def one_chunk(args):
+        d_c, i_c = args
+        d = d_c._replace(coords_test=data.coords_test, x_test=data.x_test)
+        return runner(d, i_c)
+
+    out = jax.lax.map(one_chunk, chunk_args)
+    return jax.tree_util.tree_map(
+        lambda a: a.reshape((k,) + a.shape[2:]), out
+    )
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "subsets") -> Mesh:
+    """1-D device mesh over the subset axis (ICI on a real slice)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    import numpy as np
+
+    return Mesh(np.array(devs), (axis,))
+
+
+def fit_subsets_sharded(
+    model: SpatialProbitGP,
+    part: Partition,
+    coords_test: jnp.ndarray,
+    x_test: jnp.ndarray,
+    key: jax.Array,
+    beta_init: Optional[jnp.ndarray] = None,
+    *,
+    mesh: Optional[Mesh] = None,
+    chunk_size: Optional[int] = None,
+) -> SubsetResult:
+    """Sharded fan-out: the K axis laid out over the device mesh.
+
+    Inputs are device_put with a (subsets,)-sharded leading axis and
+    the vmapped program is jitted against those shardings; because the
+    per-subset computations are independent, XLA partitions the whole
+    MCMC across devices with zero communication (SURVEY.md §5.8 —
+    the PSOCK scatter/gather becomes array layout).
+    """
+    if mesh is None:
+        mesh = make_mesh()
+    axis = mesh.axis_names[0]
+    k = part.n_subsets
+    n_dev = mesh.devices.size
+    if k % n_dev != 0:
+        raise ValueError(f"K={k} must be divisible by mesh size {n_dev}")
+
+    sharded = NamedSharding(mesh, P(axis))
+    replicated = NamedSharding(mesh, P())
+
+    part_s = Partition(
+        y=jax.device_put(part.y, sharded),
+        x=jax.device_put(part.x, sharded),
+        coords=jax.device_put(part.coords, sharded),
+        mask=jax.device_put(part.mask, sharded),
+        index=jax.device_put(part.index, sharded),
+    )
+    coords_test = jax.device_put(coords_test, replicated)
+    x_test = jax.device_put(x_test, replicated)
+
+    fn = jax.jit(
+        lambda p, ct, xt, kk: fit_subsets_vmap(
+            model, p, ct, xt, kk, beta_init, chunk_size=chunk_size
+        )
+    )
+    return fn(part_s, coords_test, x_test, key)

@@ -1,0 +1,15 @@
+"""Auxiliary subsystems: diagnostics, checkpointing, tracing
+(SURVEY.md §5 — everything the reference lacked)."""
+
+from smk_tpu.utils.diagnostics import effective_sample_size, split_rhat
+from smk_tpu.utils.checkpoint import save_pytree, load_pytree
+from smk_tpu.utils.tracing import phase_timer, PhaseTimes
+
+__all__ = [
+    "effective_sample_size",
+    "split_rhat",
+    "save_pytree",
+    "load_pytree",
+    "phase_timer",
+    "PhaseTimes",
+]

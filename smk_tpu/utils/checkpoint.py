@@ -1,0 +1,78 @@
+"""Checkpoint / resume for sampler state and result grids.
+
+The reference persists nothing — 5000-iteration MCMC state lives only
+in worker memory and dies with it (SURVEY.md §5.3-5.4). Here any
+sampler pytree (SamplerState, stacked K-subset states, SubsetResult
+grids) round-trips through a single .npz file: fields are flattened
+with their treedef recorded, so resume = load + continue the scan, and
+a failed shard is recoverable by re-running just that subset (the fit
+is a pure function of (data slice, key)).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def _is_key(leaf: Any) -> bool:
+    dt = getattr(leaf, "dtype", None)
+    return dt is not None and jax.dtypes.issubdtype(dt, jax.dtypes.prng_key)
+
+
+def save_pytree(path: str, tree: Any) -> None:
+    """Save an arbitrary array pytree to ``path`` (.npz).
+
+    Typed PRNG key arrays (part of SamplerState) are stored via their
+    raw key data and re-wrapped on load.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    arrays = {
+        f"leaf_{i}": np.asarray(
+            jax.random.key_data(leaf) if _is_key(leaf) else leaf
+        )
+        for i, leaf in enumerate(leaves)
+    }
+    arrays["__treedef__"] = np.frombuffer(
+        json.dumps(str(treedef)).encode(), dtype=np.uint8
+    )
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, path)
+
+
+def load_pytree(path: str, like: Any) -> Any:
+    """Load arrays saved by save_pytree into the structure of ``like``.
+
+    ``like`` supplies the treedef (and is also used to sanity-check
+    leaf count); dtypes/shapes come from the file.
+    """
+    with np.load(path) as data:
+        n = sum(1 for k in data.files if k.startswith("leaf_"))
+        leaves = [data[f"leaf_{i}"] for i in range(n)]
+        saved_def = (
+            json.loads(bytes(data["__treedef__"]).decode())
+            if "__treedef__" in data.files
+            else None
+        )
+    like_leaves, treedef = jax.tree_util.tree_flatten(like)
+    if treedef.num_leaves != len(leaves):
+        raise ValueError(
+            f"checkpoint has {len(leaves)} leaves, expected "
+            f"{treedef.num_leaves}"
+        )
+    if saved_def is not None and saved_def != str(treedef):
+        raise ValueError(
+            "checkpoint structure mismatch:\n"
+            f"  saved:    {saved_def}\n  expected: {treedef}"
+        )
+    leaves = [
+        jax.random.wrap_key_data(leaf) if _is_key(ref) else leaf
+        for leaf, ref in zip(leaves, like_leaves)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -1,0 +1,67 @@
+"""MCMC diagnostics computed on-device.
+
+The reference's only diagnostics are spBayes's batch acceptance
+printouts (MetaKriging_BinaryResponse.R:84, n.report=10) and visual
+traceplots (:148-149). Here ESS and split-R-hat are first-class
+outputs — ESS/sec is a BASELINE.json headline metric (SURVEY.md §5.5),
+so it must be computable from every run.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _autocovariance(x: jnp.ndarray) -> jnp.ndarray:
+    """Biased autocovariance of a 1-D chain via FFT, lags 0..n-1."""
+    n = x.shape[0]
+    xc = x - jnp.mean(x)
+    nfft = 2 * n  # zero-pad to avoid circular wrap
+    f = jnp.fft.rfft(xc, nfft)
+    acov = jnp.fft.irfft(f * jnp.conj(f), nfft)[:n].real
+    return acov / n
+
+
+def effective_sample_size(chain: jnp.ndarray) -> jnp.ndarray:
+    """Geyer initial-positive-sequence ESS.
+
+    chain: (n,) or (n, d) — ESS per column. Sums autocorrelations over
+    pairs (rho_{2t} + rho_{2t+1}) while the pair sums stay positive
+    (implemented with a running-mask cumulative product so shapes stay
+    static under jit).
+    """
+    squeeze = chain.ndim == 1
+    if squeeze:
+        chain = chain[:, None]
+    n = chain.shape[0]
+
+    def ess_one(x):
+        acov = _autocovariance(x)
+        var0 = jnp.maximum(acov[0], 1e-30)
+        rho = acov / var0
+        n_pairs = n // 2
+        pair = rho[0 : 2 * n_pairs : 2] + rho[1 : 2 * n_pairs : 2]
+        positive = pair > 0.0
+        keep = jnp.cumprod(positive.astype(x.dtype))
+        # Geyer: tau = -1 + 2 * sum of positive initial pair sums
+        tau = -1.0 + 2.0 * jnp.sum(pair * keep)
+        tau = jnp.maximum(tau, 1.0 / n)
+        return n / tau
+
+    out = jax.vmap(ess_one, in_axes=1)(chain)
+    out = jnp.minimum(out, float(n))
+    return out[0] if squeeze else out
+
+
+def split_rhat(chain: jnp.ndarray) -> jnp.ndarray:
+    """Split-R-hat per column of an (n, d) single chain (split in 2)."""
+    if chain.ndim == 1:
+        chain = chain[:, None]
+    n = chain.shape[0] // 2
+    halves = jnp.stack([chain[:n], chain[n : 2 * n]])  # (2, n, d)
+    within = jnp.mean(jnp.var(halves, axis=1, ddof=1), axis=0)
+    means = jnp.mean(halves, axis=1)
+    between = n * jnp.var(means, axis=0, ddof=1)
+    var_est = (n - 1) / n * within + between / n
+    return jnp.sqrt(var_est / jnp.maximum(within, 1e-30))

@@ -1,0 +1,35 @@
+"""Per-phase wall-clock tracing.
+
+Replaces the reference's manual Sys.time() deltas around partitioning
+and the parallel fit (MetaKriging_BinaryResponse.R:30,106,111) with a
+structured phase timer; pair with ``jax.profiler.trace`` for deep
+profiles (SURVEY.md §5.1).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterator
+
+
+@dataclass
+class PhaseTimes:
+    seconds: Dict[str, float] = field(default_factory=dict)
+
+    def record(self, name: str, secs: float) -> None:
+        self.seconds[name] = self.seconds.get(name, 0.0) + secs
+
+    def as_dict(self) -> Dict[str, float]:
+        return dict(self.seconds)
+
+
+@contextlib.contextmanager
+def phase_timer(times: PhaseTimes, name: str) -> Iterator[None]:
+    """Time a phase; remember to block_until_ready on async results."""
+    start = time.perf_counter()
+    try:
+        yield
+    finally:
+        times.record(name, time.perf_counter() - start)

@@ -1,0 +1,25 @@
+"""Test config: force CPU with 8 virtual devices.
+
+This is the standard JAX trick (SURVEY.md §4): vmap/shard_map
+semantics are identical on CPU, so K-sharded runs are testable without
+TPU hardware; golden values are keyed by explicit PRNG seeds (the
+reference's unseeded `sample` made runs unreproducible).
+
+Note: this environment's sitecustomize force-registers the TPU (axon)
+backend regardless of JAX_PLATFORMS, so the override must go through
+jax.config, with the XLA host-device-count flag exported before the
+CPU client initializes.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)

@@ -1,0 +1,119 @@
+"""Tests for the partitioner (L2) and the combiners (L5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from smk_tpu.parallel.partition import random_partition
+from smk_tpu.parallel.combine import (
+    combine_quantile_grids,
+    wasserstein_barycenter,
+    weiszfeld_median,
+)
+
+
+def _toy(n=103, q=2, p=2, d=2, seed=0):
+    rng = np.random.default_rng(seed)
+    y = jnp.asarray(rng.integers(0, 2, size=(n, q)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(n, q, p)), jnp.float32)
+    coords = jnp.asarray(rng.uniform(size=(n, d)), jnp.float32)
+    return y, x, coords
+
+
+class TestPartition:
+    def test_disjoint_cover(self):
+        """Every original row appears exactly once across subsets —
+        the reference's disjointness property (R:31,40)."""
+        y, x, coords = _toy(n=103)
+        part = random_partition(jax.random.key(0), y, x, coords, 5)
+        idx = np.asarray(part.index).ravel()
+        real = np.sort(idx[idx >= 0])
+        np.testing.assert_array_equal(real, np.arange(103))
+
+    def test_mask_counts(self):
+        y, x, coords = _toy(n=103)
+        part = random_partition(jax.random.key(0), y, x, coords, 5)
+        assert part.subset_size == 21  # ceil(103/5)
+        assert int(np.asarray(part.mask).sum()) == 103
+
+    def test_slices_match_source(self):
+        y, x, coords = _toy(n=40)
+        part = random_partition(jax.random.key(1), y, x, coords, 4)
+        idx = np.asarray(part.index)
+        for k in range(4):
+            for i in range(part.subset_size):
+                if idx[k, i] >= 0:
+                    np.testing.assert_allclose(
+                        np.asarray(part.y[k, i]), np.asarray(y[idx[k, i]])
+                    )
+                    np.testing.assert_allclose(
+                        np.asarray(part.coords[k, i]),
+                        np.asarray(coords[idx[k, i]]),
+                    )
+
+    def test_pad_coords_far_and_distinct(self):
+        y, x, coords = _toy(n=10)
+        part = random_partition(jax.random.key(2), y, x, coords, 4)  # m=3, 2 pads
+        mask = np.asarray(part.mask)
+        pc = np.asarray(part.coords)
+        pads = pc[mask == 0]
+        assert (pads > np.asarray(coords).max()).all()
+        # all padded coords distinct
+        assert len({tuple(r) for r in pads.round(6)}) == len(pads)
+
+    def test_deterministic_by_key(self):
+        y, x, coords = _toy(n=50)
+        p1 = random_partition(jax.random.key(3), y, x, coords, 5)
+        p2 = random_partition(jax.random.key(3), y, x, coords, 5)
+        np.testing.assert_array_equal(np.asarray(p1.index), np.asarray(p2.index))
+        p3 = random_partition(jax.random.key(4), y, x, coords, 5)
+        assert not np.array_equal(np.asarray(p1.index), np.asarray(p3.index))
+
+
+class TestCombine:
+    def test_barycenter_is_mean(self):
+        rng = np.random.default_rng(1)
+        grids = jnp.asarray(np.sort(rng.normal(size=(6, 50, 3)), axis=1), jnp.float32)
+        out = wasserstein_barycenter(grids)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(grids).mean(0), rtol=1e-5
+        )
+
+    def test_weiszfeld_identical_inputs(self):
+        g = jnp.asarray(np.sort(np.random.default_rng(2).normal(size=(50, 2)), 0), jnp.float32)
+        grids = jnp.stack([g] * 5)
+        med = weiszfeld_median(grids)
+        np.testing.assert_allclose(np.asarray(med), np.asarray(g), atol=1e-4)
+
+    def test_weiszfeld_robust_to_outlier(self):
+        """Geometric median should sit near the majority cluster while
+        the mean gets dragged by the outlier subset."""
+        rng = np.random.default_rng(3)
+        base = np.sort(rng.normal(size=(50, 1)), axis=0).astype(np.float32)
+        grids = np.stack([base + rng.normal(scale=0.01, size=(50, 1)).astype(np.float32)
+                          for _ in range(7)] + [base + 100.0])
+        med = np.asarray(weiszfeld_median(jnp.asarray(grids), n_iter=100))
+        mean = np.asarray(wasserstein_barycenter(jnp.asarray(grids)))
+        err_med = np.abs(med - base).mean()
+        err_mean = np.abs(mean - base).mean()
+        assert err_med < 0.5
+        assert err_mean > 10.0
+
+    def test_weiszfeld_monotone_output(self):
+        rng = np.random.default_rng(4)
+        grids = jnp.asarray(np.sort(rng.normal(size=(5, 80, 2)), axis=1), jnp.float32)
+        med = np.asarray(weiszfeld_median(grids))
+        assert (np.diff(med, axis=0) >= -1e-5).all()
+
+    def test_dispatch(self):
+        grids = jnp.asarray(
+            np.sort(np.random.default_rng(5).normal(size=(4, 30, 2)), 1), jnp.float32
+        )
+        np.testing.assert_allclose(
+            np.asarray(combine_quantile_grids(grids, "wasserstein_mean")),
+            np.asarray(wasserstein_barycenter(grids)),
+        )
+        import pytest
+
+        with pytest.raises(ValueError):
+            combine_quantile_grids(grids, "nope")

@@ -1,0 +1,83 @@
+"""Tests for diagnostics (ESS, R-hat) and checkpoint round-trips."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from smk_tpu.models.probit_gp import SamplerState
+from smk_tpu.utils.checkpoint import load_pytree, save_pytree
+from smk_tpu.utils.diagnostics import effective_sample_size, split_rhat
+
+
+class TestESS:
+    def test_iid_chain_ess_near_n(self):
+        x = jax.random.normal(jax.random.key(0), (4000,))
+        ess = float(effective_sample_size(x))
+        assert 2000 < ess <= 4000
+
+    def test_ar1_chain_ess_matches_theory(self):
+        # AR(1) with coef rho has ESS/n = (1-rho)/(1+rho)
+        rho, n = 0.9, 20000
+        rng = np.random.default_rng(1)
+        e = rng.standard_normal(n).astype(np.float32)
+        x = np.empty(n, np.float32)
+        x[0] = e[0]
+        for t in range(1, n):
+            x[t] = rho * x[t - 1] + e[t]
+        ess = float(effective_sample_size(jnp.asarray(x)))
+        want = n * (1 - rho) / (1 + rho)
+        assert 0.5 * want < ess < 2.0 * want
+
+    def test_constant_chain_small_ess(self):
+        x = jnp.ones((1000,))
+        ess = float(effective_sample_size(x))
+        assert ess <= 1000.0
+
+    def test_columnwise(self):
+        x = jax.random.normal(jax.random.key(2), (2000, 3))
+        ess = effective_sample_size(x)
+        assert ess.shape == (3,)
+
+
+class TestRhat:
+    def test_stationary_chain_near_one(self):
+        x = jax.random.normal(jax.random.key(3), (4000, 2))
+        r = np.asarray(split_rhat(x))
+        assert (np.abs(r - 1.0) < 0.05).all()
+
+    def test_drifting_chain_flags(self):
+        x = jnp.linspace(0.0, 5.0, 2000)[:, None] + jax.random.normal(
+            jax.random.key(4), (2000, 1)
+        ) * 0.1
+        r = float(split_rhat(x)[0])
+        assert r > 1.5
+
+
+class TestCheckpoint:
+    def test_round_trip_state(self, tmp_path):
+        st = SamplerState(
+            beta=jnp.ones((2, 3)),
+            u=jnp.zeros((10, 2)),
+            a=jnp.eye(2),
+            phi=jnp.asarray([5.0, 6.0]),
+            chol_r=jnp.broadcast_to(jnp.eye(10), (2, 10, 10)),
+            key=jax.random.key(0),
+            phi_accept=jnp.zeros((2,)),
+        )
+        path = os.path.join(tmp_path, "ckpt.npz")
+        save_pytree(path, st)
+        st2 = load_pytree(path, st)
+        for a, b in zip(jax.tree_util.tree_leaves(st), jax.tree_util.tree_leaves(st2)):
+            if jax.dtypes.issubdtype(a.dtype, jax.dtypes.prng_key):
+                a, b = jax.random.key_data(a), jax.random.key_data(b)
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_leaf_count_mismatch_raises(self, tmp_path):
+        import pytest
+
+        path = os.path.join(tmp_path, "c.npz")
+        save_pytree(path, {"a": jnp.ones(3)})
+        with pytest.raises(ValueError):
+            load_pytree(path, {"a": jnp.ones(3), "b": jnp.ones(2)})
