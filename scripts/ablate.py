@@ -1,0 +1,62 @@
+"""Ablation harness: times the subset-fit scan under config variants.
+
+Usage: python scripts/ablate.py '{"u_solver":"cg","phi_update_every":1}'
+Env: ABL_M, ABL_K, ABL_Q, ABL_SAMPLES, ABL_T (test sites)
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from smk_tpu.config import SMKConfig
+from smk_tpu.models.probit_gp import SpatialGPSampler
+from smk_tpu.parallel.executor import fit_subsets_vmap
+from smk_tpu.parallel.partition import Partition
+
+M = int(os.environ.get("ABL_M", 1000))
+K = int(os.environ.get("ABL_K", 10))
+Q = int(os.environ.get("ABL_Q", 1))
+SAMPLES = int(os.environ.get("ABL_SAMPLES", 2000))
+T = int(os.environ.get("ABL_T", 64))
+
+
+def main():
+    overrides = json.loads(sys.argv[1]) if len(sys.argv) > 1 else {}
+    rng = np.random.default_rng(0)
+    part = Partition(
+        y=jnp.asarray(rng.integers(0, 2, (K, M, Q)), jnp.float32),
+        x=jnp.asarray(rng.normal(size=(K, M, Q, 2)), jnp.float32),
+        coords=jnp.asarray(rng.uniform(size=(K, M, 2)), jnp.float32),
+        mask=jnp.ones((K, M), jnp.float32),
+        index=jnp.zeros((K, M), jnp.int32),
+    )
+    ct = jnp.asarray(rng.uniform(size=(T, 2)), jnp.float32)
+    xt = jnp.asarray(rng.normal(size=(T, Q, 2)), jnp.float32)
+
+    cfg = SMKConfig(**{"n_subsets": K, "n_samples": SAMPLES,
+                       "burn_in_frac": 0.5, **overrides})
+    model = SpatialGPSampler(cfg)
+    f = jax.jit(
+        lambda p, kk: fit_subsets_vmap(model, p, ct, xt, kk).param_grid
+    )
+    # NB: through the remote-TPU tunnel block_until_ready does not
+    # actually wait; a host fetch does.
+    _ = float(jnp.sum(f(part, jax.random.key(0))))
+    t0 = time.perf_counter()
+    _ = float(jnp.sum(f(part, jax.random.key(1))))
+    dt = time.perf_counter() - t0
+    print(
+        f"m={M} K={K} q={Q} iters={SAMPLES} {overrides}: "
+        f"{dt:.2f}s = {dt / SAMPLES * 1e3:.3f} ms/iter"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+"""Ablation micro-benchmark of the Gibbs step cost on real hardware.
+
+Times (per iteration, batched over K subsets like the real fan-out):
+  - full Gibbs scan iteration
+  - batched m x m Cholesky alone (x2: the phi proposal + the R+D solve)
+  - batched triangular solves
+  - the augmentation (truncnorm / PG) elementwise stage
+Run on TPU:  python scripts/profile_step.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from smk_tpu.config import SMKConfig
+from smk_tpu.models.probit_gp import SpatialGPSampler, SubsetData
+from smk_tpu.ops.chol import jittered_cholesky, tri_solve
+from smk_tpu.ops.truncnorm import truncated_normal
+
+K = int(os.environ.get("PROF_K", 10))
+M = int(os.environ.get("PROF_M", 1000))
+Q = int(os.environ.get("PROF_Q", 1))
+ITERS = int(os.environ.get("PROF_ITERS", 200))
+
+
+def timeit(fn, *args, n=3):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    best = np.inf
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    rng = np.random.default_rng(0)
+    coords = jnp.asarray(rng.uniform(size=(K, M, 2)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(K, M, Q, 2)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, (K, M, Q)), jnp.float32)
+    mask = jnp.ones((K, M), jnp.float32)
+    ct = jnp.asarray(rng.uniform(size=(64, 2)), jnp.float32)
+    xt = jnp.asarray(rng.normal(size=(64, Q, 2)), jnp.float32)
+
+    cfg = SMKConfig(n_subsets=K, n_samples=ITERS, burn_in_frac=0.5)
+    model = SpatialGPSampler(cfg)
+
+    from smk_tpu.parallel.partition import Partition
+    from smk_tpu.parallel.executor import fit_subsets_vmap
+
+    part = Partition(y=y, x=x, coords=coords, mask=mask,
+                     index=jnp.zeros((K, M), jnp.int32))
+
+    t_full = timeit(
+        jax.jit(lambda: fit_subsets_vmap(model, part, ct, xt, jax.random.key(0)).param_grid),
+        n=2,
+    )
+    per_iter_full = t_full / ITERS
+    print(f"full pipeline: {t_full:.3f}s for {ITERS} iters x K={K} m={M} q={Q}"
+          f" -> {per_iter_full*1e3:.3f} ms/iter")
+
+    # batched cholesky of a K*q stack of (m, m) SPD matrices
+    with jax.default_matmul_precision("highest"):
+        spd = jnp.asarray(
+            rng.uniform(0.2, 0.4, (K * Q, M, M)), jnp.float32
+        )
+        spd = 0.5 * (spd + spd.transpose(0, 2, 1)) + 2.0 * jnp.eye(M)[None]
+        f_chol = jax.jit(lambda s: jittered_cholesky(s, 1e-5))
+        t_chol = timeit(f_chol, spd)
+        print(f"batched chol (K*q={K*Q}, m={M}): {t_chol*1e3:.3f} ms "
+              f"-> 2 per iter = {2*t_chol*1e3:.3f} ms")
+
+        l = f_chol(spd)
+        b = jnp.asarray(rng.normal(size=(K * Q, M, 64)), jnp.float32)
+        f_tri = jax.jit(lambda l_, b_: tri_solve(l_, b_))
+        t_tri = timeit(f_tri, l, b)
+        print(f"batched trisolve (rhs width 64): {t_tri*1e3:.3f} ms")
+
+        c = jnp.asarray(rng.normal(size=(K, M, Q)), jnp.float32)
+        f_tn = jax.jit(
+            lambda cc: truncated_normal(jax.random.key(1), cc, cc > 0)
+        )
+        t_tn = timeit(f_tn, c)
+        print(f"truncnorm ({K}x{M}x{Q}): {t_tn*1e3:.3f} ms")
+
+        # dense matvec through R (the CG building block): batched m x m @ m x 1
+        v = jnp.asarray(rng.normal(size=(K * Q, M, 1)), jnp.float32)
+        f_mv = jax.jit(lambda s_, v_: s_ @ v_)
+        t_mv = timeit(f_mv, spd, v)
+        print(f"batched dense matvec: {t_mv*1e3:.3f} ms "
+              f"(30 CG iters = {30*t_mv*1e3:.3f} ms)")
+
+
+if __name__ == "__main__":
+    main()

@@ -35,6 +35,7 @@ from smk_tpu.parallel.combine import (
     combine_quantile_grids,
 )
 from smk_tpu.models.probit_gp import (
+    SpatialGPSampler,
     SpatialProbitGP,
     SamplerState,
     SubsetResult,
@@ -53,6 +54,7 @@ __all__ = [
     "wasserstein_barycenter",
     "weiszfeld_median",
     "combine_quantile_grids",
+    "SpatialGPSampler",
     "SpatialProbitGP",
     "SamplerState",
     "SubsetResult",

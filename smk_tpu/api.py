@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from smk_tpu.config import SMKConfig
-from smk_tpu.models.probit_gp import SpatialProbitGP, SubsetResult, n_params
+from smk_tpu.models.probit_gp import SpatialGPSampler, SubsetResult, n_params
 from smk_tpu.ops.glm import glm_warm_start
 from smk_tpu.ops.quantiles import (
     credible_summary,
@@ -30,7 +30,7 @@ from smk_tpu.ops.quantiles import (
 from smk_tpu.parallel.combine import combine_quantile_grids
 from smk_tpu.parallel.executor import fit_subsets_sharded, fit_subsets_vmap
 from smk_tpu.parallel.partition import random_partition
-from smk_tpu.utils.tracing import PhaseTimes, phase_timer
+from smk_tpu.utils.tracing import PhaseTimes, device_sync, phase_timer
 
 
 class MetaKrigingResult(NamedTuple):
@@ -138,27 +138,21 @@ def fit_meta_kriging(
     weight: binomial trial count (reference `weight`, R:53,81).
     """
     cfg = config or SMKConfig()
-    if cfg.link != "probit":
-        raise NotImplementedError(
-            "the TPU-native sampler is Albert–Chib probit (north star); "
-            "logit-link sampling is not yet implemented — use "
-            "link='probit'"
-        )
     times = PhaseTimes()
     k_part, k_fit, k_resample = jax.random.split(key, 3)
 
     with phase_timer(times, "partition"):
         part = random_partition(k_part, y, x, coords, cfg.n_subsets)
-        jax.block_until_ready(part.y)
+        device_sync(part.y)
 
     with phase_timer(times, "warm_start"):
         y_long, x_long = stacked_design(y, x)
         fit = glm_warm_start(y_long, x_long, weight=weight, link=cfg.link)
         q, p = x.shape[1], x.shape[2]
         beta_init = fit.coef.reshape(q, p)
-        jax.block_until_ready(beta_init)
+        device_sync(beta_init)
 
-    model = SpatialProbitGP(cfg, weight=weight)
+    model = SpatialGPSampler(cfg, weight=weight)
     with phase_timer(times, "subset_fits"):
         if sharded:
             results = fit_subsets_sharded(
@@ -170,7 +164,7 @@ def fit_meta_kriging(
                 model, part, coords_test, x_test, k_fit, beta_init,
                 chunk_size=chunk_size,
             )
-        jax.block_until_ready(results.param_grid)
+        device_sync(results.param_grid)
 
     with phase_timer(times, "combine"):
         param_grid = combine_quantile_grids(
@@ -181,7 +175,7 @@ def fit_meta_kriging(
             results.w_grid, cfg.combiner,
             n_iter=cfg.weiszfeld_iters, eps=cfg.weiszfeld_eps,
         )
-        jax.block_until_ready(param_grid)
+        device_sync((param_grid, w_grid))
 
     with phase_timer(times, "resample_predict"):
         dense_par = interp_quantile_grid(param_grid, cfg.interp_grid_step)
@@ -195,7 +189,7 @@ def fit_meta_kriging(
         param_quant = credible_summary(sample_par)
         w_quant = credible_summary(sample_w)
         p_quant = credible_summary(p_samples)
-        jax.block_until_ready(p_quant)
+        device_sync((p_quant, param_quant, w_quant))
 
     return MetaKrigingResult(
         param_grid=param_grid,

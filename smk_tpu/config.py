@@ -77,6 +77,19 @@ class SMKConfig:
     # toward 0.43 (:83) with a fixed, jit-stable step.
     phi_step: float = 0.5
 
+    # phi is Metropolis-updated every this many Gibbs sweeps (a valid
+    # deterministic-scan schedule). Each phi update costs the one
+    # remaining O(m^3) Cholesky per component; raising this trades phi
+    # mixing for wall-clock at large m.
+    phi_update_every: int = 1
+
+    # Solver for the u-update's (R + D) system: "chol" = exact dense
+    # Cholesky; "cg" = fixed-iteration conjugate gradient with the
+    # matvec through the carried chol(R) factor — O(cg_iters * m^2)
+    # batched matmuls instead of O(m^3), the scaling-regime choice.
+    u_solver: str = "chol"
+    cg_iters: int = 64
+
     # Numerics.
     jitter: float = 1e-5
     mask_noise_var: float = 1e8  # pseudo noise variance on padded rows
@@ -96,6 +109,10 @@ class SMKConfig:
             raise ValueError(f"combiner must be one of {COMBINERS}")
         if not 0.0 < self.burn_in_frac < 1.0:
             raise ValueError("burn_in_frac must be in (0, 1)")
+        if self.u_solver not in ("chol", "cg"):
+            raise ValueError("u_solver must be 'chol' or 'cg'")
+        if self.phi_update_every < 1:
+            raise ValueError("phi_update_every must be >= 1")
 
     @property
     def n_burn_in(self) -> int:

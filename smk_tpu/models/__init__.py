@@ -3,10 +3,11 @@ replacement for spBayes::spMvGLM / spPredict — reference L1/L3 layers,
 SURVEY.md §1)."""
 
 from smk_tpu.models.probit_gp import (
+    SpatialGPSampler,
     SpatialProbitGP,
     SubsetData,
     SamplerState,
     SubsetResult,
 )
 
-__all__ = ["SpatialProbitGP", "SubsetData", "SamplerState", "SubsetResult"]
+__all__ = ["SpatialGPSampler", "SpatialProbitGP", "SubsetData", "SamplerState", "SubsetResult"]

@@ -9,10 +9,15 @@ redoing a dense (q·m)×(q·m) Cholesky every iteration.
 
 The TPU-first redesign (NOT a translation):
 
-- **Probit link + Albert–Chib latents** (the BASELINE.json north
-  star): each binary observation gets z ~ N(eta, 1) truncated by y,
-  making every other update conjugate — no per-block MH tuning, no
+- **Conjugate data augmentation instead of tuned Metropolis.** Both
+  links reduce the binomial likelihood to heteroscedastic Gaussian
+  pseudo-observations (z, omega) — z with precision omega — after
+  which every update is conjugate: no per-block MH tuning, no
   Roberts–Rosenthal adaptation (R:83), fully static control flow.
+    - probit: Albert–Chib truncated-normal latents (the BASELINE.json
+      north star); omega = weight (constant).
+    - logit (the reference's own link, R:160): Pólya-Gamma
+      augmentation, omega ~ PG(weight, eta), z = (y - weight/2)/omega.
 - **Component-GP factorization of the LMC**: the latent surface is
   w = U A^T with U's q columns independent unit-variance GPs and A
   lower-triangular (cross-covariance K = A A^T at distance zero —
@@ -29,9 +34,10 @@ The TPU-first redesign (NOT a translation):
   their latents revert to the prior and contribute nothing.
 
 Updates per iteration:
-  1. z    — truncated-normal Albert–Chib latents (binomial `weight`
+  1. (z, omega) — link-specific augmentation (binomial `weight`
             trials supported, matching the weights matrix at R:81).
-  2. beta — conjugate Gaussian per response (flat prior, R:63).
+  2. beta — conjugate Gaussian per response (flat prior, R:63),
+            omega-weighted.
   3. phi  — random-walk MH on a logit-transformed Unif(lo, hi) support
             per component (prior bounds from R:63).
   4. U    — per-component Gaussian conditional drawn exactly by
@@ -61,8 +67,10 @@ from smk_tpu.ops.chol import (
     jittered_cholesky,
     tri_solve,
 )
+from smk_tpu.ops.cg import cg_solve
 from smk_tpu.ops.distance import cross_distance, pairwise_distance
 from smk_tpu.ops.kernels import correlation
+from smk_tpu.ops.polya_gamma import sample_pg
 from smk_tpu.ops.quantiles import quantile_grid
 from smk_tpu.ops.truncnorm import sample_albert_chib_latent
 
@@ -117,9 +125,10 @@ def n_params(q: int, p: int) -> int:
     return q * p + q * (q + 1) // 2 + q
 
 
-class SpatialProbitGP:
-    """Single-subset sampler. All config is static; `run` is jit/vmap
-    friendly (pure function of (data, init_state))."""
+class SpatialGPSampler:
+    """Single-subset sampler for both links (config.link: "probit" via
+    Albert–Chib, "logit" via Pólya-Gamma). All config is static; `run`
+    is jit/vmap friendly (pure function of (data, init_state))."""
 
     def __init__(self, config: SMKConfig, *, weight: int = 1):
         self.config = config
@@ -159,12 +168,12 @@ class SpatialProbitGP:
     # ------------------------------------------------------------------
     # One Gibbs iteration
     # ------------------------------------------------------------------
-    def _gibbs_step(self, data, consts, state, *, collect: bool):
+    def _gibbs_step(self, data, consts, state, it, *, collect: bool):
         cfg = self.config
         weight = self.weight
         m, q, p = data.x.shape
         dtype = data.x.dtype
-        dist, chol_g, dist_cross, dist_test = consts
+        dist, dist_cross, dist_test = consts
         mask = data.mask
 
         key, kz, kb, kphi, kprop, ku_prior, ku_noise, ka, kpred = jax.random.split(
@@ -173,23 +182,36 @@ class SpatialProbitGP:
 
         beta, u, a, phi = state.beta, state.u, state.a, state.phi
 
-        # --- 1. Albert–Chib latent update -----------------------------
+        # --- 1. link augmentation: Gaussian pseudo-obs (z, omega) -----
+        # After this step the model is z ~ N(eta + w, 1/omega)
+        # elementwise; both links share every downstream update.
         eta_fixed = jnp.einsum("mqp,qp->mq", data.x, beta)
         w = u @ a.T  # (m, q)
         mu = eta_fixed + w
-        zbar = sample_albert_chib_latent(kz, mu, data.y, weight)
+        if cfg.link == "probit":
+            zbar = sample_albert_chib_latent(kz, mu, data.y, weight)
+            omega = jnp.full((m, q), float(weight), dtype)
+        else:  # logit: Pólya-Gamma augmentation
+            omega = sample_pg(kz, weight, mu)
+            zbar = (data.y - 0.5 * weight) / omega
+        womega = omega * mask[:, None]  # masked precisions (m, q)
 
-        # --- 2. beta | z, w (conjugate, flat prior) -------------------
-        resid_b = (zbar - w) * mask[:, None]  # (m, q)
-        rhs = jnp.einsum("mqp,mq->qp", data.x, resid_b)  # X_j^T M r_j
-        mean_b = jax.vmap(chol_solve)(chol_g, rhs)  # (q, p)
+        # --- 2. beta | z, w (conjugate, flat prior, omega-weighted) ---
+        resid_b = zbar - w  # (m, q)
+        prec_b = jnp.einsum("mqp,mq,mqr->qpr", data.x, womega, data.x)
+        chol_pb = jittered_cholesky(prec_b, 1e-6)
+        rhs = jnp.einsum("mqp,mq->qp", data.x, womega * resid_b)
+        mean_b = jax.vmap(chol_solve)(chol_pb, rhs)  # (q, p)
         noise = jax.vmap(lambda L, e: tri_solve(L, e, trans=True))(
-            chol_g, jax.random.normal(kb, (q, p), dtype)
+            chol_pb, jax.random.normal(kb, (q, p), dtype)
         )
-        beta = mean_b + noise / jnp.sqrt(jnp.asarray(float(weight), dtype))
+        beta = mean_b + noise
         eta_fixed = jnp.einsum("mqp,qp->mq", data.x, beta)
 
         # --- 3. phi | u (logit-RW MH on Unif support) -----------------
+        # Runs every cfg.phi_update_every sweeps (deterministic-scan
+        # Gibbs schedule); skipped sweeps pay zero Cholesky cost via
+        # lax.cond. This is the only remaining O(m^3) factorization.
         lo = jnp.asarray(cfg.priors.phi_min, dtype)
         hi = jnp.asarray(cfg.priors.phi_max, dtype)
 
@@ -200,84 +222,115 @@ class SpatialProbitGP:
                 chol_r
             )
 
-        def chol_of(phis):
-            r = correlation(dist[None], phis[:, None, None], cfg.cov_model)
-            return jittered_cholesky(r, cfg.jitter)
+        def phi_mh(_):
+            def chol_of(phis):
+                r = correlation(dist[None], phis[:, None, None], cfg.cov_model)
+                return jittered_cholesky(r, cfg.jitter)
 
-        t_cur = jnp.log((phi - lo) / (hi - phi))
-        t_prop = t_cur + cfg.phi_step * jax.random.normal(kprop, (q,), dtype)
-        sig_cur = jax.nn.sigmoid(t_cur)
-        sig_prop = jax.nn.sigmoid(t_prop)
-        phi_prop = lo + (hi - lo) * sig_prop
-        log_jac_cur = jnp.log(sig_cur * (1.0 - sig_cur))
-        log_jac_prop = jnp.log(sig_prop * (1.0 - sig_prop))
+            t_cur = jnp.log((phi - lo) / (hi - phi))
+            t_prop = t_cur + cfg.phi_step * jax.random.normal(kprop, (q,), dtype)
+            sig_cur = jax.nn.sigmoid(t_cur)
+            sig_prop = jax.nn.sigmoid(t_prop)
+            phi_prop = lo + (hi - lo) * sig_prop
+            log_jac_cur = jnp.log(sig_cur * (1.0 - sig_cur))
+            log_jac_prop = jnp.log(sig_prop * (1.0 - sig_prop))
 
-        chol_cur = state.chol_r  # factored when phi was last accepted
-        chol_prop = chol_of(phi_prop)
-        log_ratio = (
-            u_loglik(chol_prop)
-            + log_jac_prop
-            - u_loglik(chol_cur)
-            - log_jac_cur
-        )
-        accept = jnp.log(
-            jax.random.uniform(kphi, (q,), dtype, minval=1e-12)
-        ) < log_ratio
-        phi = jnp.where(accept, phi_prop, phi)
-        chol_r = jnp.where(accept[:, None, None], chol_prop, chol_cur)
-        phi_accept = state.phi_accept + accept.astype(dtype)
+            chol_cur = state.chol_r  # factored when phi last changed
+            chol_prop = chol_of(phi_prop)
+            log_ratio = (
+                u_loglik(chol_prop)
+                + log_jac_prop
+                - u_loglik(chol_cur)
+                - log_jac_cur
+            )
+            accept = jnp.log(
+                jax.random.uniform(kphi, (q,), dtype, minval=1e-12)
+            ) < log_ratio
+            return (
+                jnp.where(accept, phi_prop, phi),
+                jnp.where(accept[:, None, None], chol_prop, chol_cur),
+                accept.astype(dtype),
+            )
+
+        def phi_keep(_):
+            return phi, state.chol_r, jnp.zeros((q,), dtype)
+
+        if cfg.phi_update_every == 1:
+            phi, chol_r, accepted = phi_mh(None)
+        else:
+            phi, chol_r, accepted = lax.cond(
+                it % cfg.phi_update_every == 0, phi_mh, phi_keep, None
+            )
+        phi_accept = state.phi_accept + accepted
 
         # --- 4. U | z, beta, A, phi — per-component Matheron draw -----
-        ata_diag = jnp.sum(a * a, axis=0)  # (q,) (A^T A)_jj
+        # Pseudo-obs for component j: precision c_i = sum_l womega_il
+        # A_lj^2, linear term b_i = sum_l womega_il A_lj resid_il;
+        # Matheron with heteroscedastic noise D = diag(1/c).
         e0 = zbar - eta_fixed  # (m, q)
         big = jnp.asarray(cfg.mask_noise_var, dtype)
         ku_priors = jax.random.split(ku_prior, q)
         ku_noises = jax.random.split(ku_noise, q)
         for j in range(q):
             a_j = a[:, j]  # (q,)
-            c_scale = jnp.maximum(ata_diag[j], 1e-12)
             # residual excluding component j's contribution
             w_full = u @ a.T
             partial = e0 - w_full + jnp.outer(u[:, j], a_j)
-            ytilde = (partial @ a_j) / c_scale  # (m,)
-            d_vec = jnp.where(
-                mask > 0, 1.0 / (weight * c_scale), big
-            )  # (m,) noise variance of the pseudo-obs
+            c_vec = womega @ (a_j * a_j)  # (m,)
+            b_vec = (womega * partial) @ a_j  # (m,)
+            c_safe = jnp.maximum(c_vec, 1.0 / big)
+            ytilde = b_vec / c_safe
+            d_vec = jnp.minimum(1.0 / c_safe, big)  # noise variance
             l_j = chol_r[j]
             # prior draw u* = L xi  and noise draw eta* = sqrt(d) xi2
             u_star = l_j @ jax.random.normal(ku_priors[j], (m,), dtype)
             eta_star = jnp.sqrt(d_vec) * jax.random.normal(
                 ku_noises[j], (m,), dtype
             )
-            # R rebuilt elementwise from the distance matrix — O(m^2),
-            # not the O(m^3) matmul L @ L^T (same matrix up to jitter)
-            r_mat = correlation(dist, phi[j], cfg.cov_model) + cfg.jitter * jnp.eye(
-                m, dtype=dtype
-            )
-            chol_m = jittered_cholesky(
-                r_mat + jnp.diag(d_vec), cfg.jitter
-            )
-            s = chol_solve(chol_m, ytilde - u_star - eta_star)
-            u = u.at[:, j].set(u_star + r_mat @ s)
+            rhs_vec = ytilde - u_star - eta_star
+            if cfg.u_solver == "cg":
+                # (R + D) x = rhs with R applied as L (L^T x): two
+                # batched matmuls per CG step — O(cg_iters * m^2) of
+                # MXU work replaces the O(m^3) factorization; Jacobi
+                # preconditioning absorbs the huge padded-row d's.
+                def mv(x):
+                    return l_j @ (l_j.T @ x) + d_vec * x
+
+                s = cg_solve(
+                    mv, rhs_vec, cfg.cg_iters, diag=1.0 + cfg.jitter + d_vec
+                )
+                u = u.at[:, j].set(u_star + l_j @ (l_j.T @ s))
+            else:
+                # exact dense path: R rebuilt elementwise from the
+                # distance matrix — O(m^2), not the O(m^3) L @ L^T
+                r_mat = correlation(
+                    dist, phi[j], cfg.cov_model
+                ) + cfg.jitter * jnp.eye(m, dtype=dtype)
+                chol_m = jittered_cholesky(
+                    r_mat + jnp.diag(d_vec), cfg.jitter
+                )
+                s = chol_solve(chol_m, rhs_vec)
+                u = u.at[:, j].set(u_star + r_mat @ s)
 
         # --- 5. A | z, beta, U (conjugate rows, lower-triangular) -----
-        mu_mask = mask[:, None] * u  # masked design (m, q)
-        s_mat = weight * (u.T @ mu_mask)  # (q, q) shared Gram
-        t_mat = weight * (mu_mask.T @ e0)  # (q, q); column l is rhs for row l
+        # Row l regresses e0[:, l] on U with per-location precision
+        # womega[:, l]; each row gets its own omega-weighted Gram.
+        s_all = jnp.einsum("mi,ml,mj->lij", u, womega, u)  # (q, q, q)
+        rhs_all = jnp.einsum("mi,ml->li", u, womega * e0)  # (q, q)
         prior_prec = 1.0 / jnp.asarray(cfg.priors.a_scale, dtype) ** 2
         row_idx = jnp.arange(q)
         # entries k > l are pinned to ~0 by a huge prior precision —
         # one batched (q, q) solve replaces a ragged per-row loop
         pin = jnp.where(row_idx[None, :] <= row_idx[:, None], prior_prec, 1e12)
 
-        def draw_row(rhs_l, pin_l, key_l):
-            p_l = s_mat + jnp.diag(pin_l)
+        def draw_row(s_l, rhs_l, pin_l, key_l):
+            p_l = s_l + jnp.diag(pin_l)
             chol_p = jittered_cholesky(p_l, cfg.jitter)
             mean_l = chol_solve(chol_p, rhs_l)
             z = jax.random.normal(key_l, (q,), dtype)
             return mean_l + tri_solve(chol_p, z, trans=True)
 
-        a_rows = jax.vmap(draw_row)(t_mat.T, pin, jax.random.split(ka, q))
+        a_rows = jax.vmap(draw_row)(s_all, rhs_all, pin, jax.random.split(ka, q))
         a = jnp.tril(a_rows)
 
         new_state = SamplerState(
@@ -351,35 +404,41 @@ class SpatialProbitGP:
         dist = pairwise_distance(data.coords)
         dist_cross = cross_distance(data.coords, data.coords_test)
         dist_test = pairwise_distance(data.coords_test)
-        # Gram matrices X_j^T M X_j for the conjugate beta update.
-        xm = data.x * data.mask[:, None, None]
-        gram = jnp.einsum("mqp,mqr->qpr", xm, data.x)
-        chol_g = jittered_cholesky(gram, 1e-6)
-        consts = (dist, chol_g, dist_cross, dist_test)
+        consts = (dist, dist_cross, dist_test)
 
-        burn_step = lambda st, _: (
-            self._gibbs_step(data, consts, st, collect=False)[0],
+        burn_step = lambda st, it: (
+            self._gibbs_step(data, consts, st, it, collect=False)[0],
             None,
         )
-        keep_step = lambda st, _: self._gibbs_step(
-            data, consts, st, collect=True
+        keep_step = lambda st, it: self._gibbs_step(
+            data, consts, st, it, collect=True
         )
 
         state, _ = lax.scan(
-            burn_step, init_state, None, length=cfg.n_burn_in
+            burn_step, init_state, jnp.arange(cfg.n_burn_in)
         )
         # reset acceptance counter so the reported rate is post-burn-in
         state = state._replace(phi_accept=jnp.zeros_like(state.phi_accept))
+        kept_iters = jnp.arange(cfg.n_burn_in, cfg.n_samples)
         state, (param_draws, w_draws) = lax.scan(
-            keep_step, state, None, length=cfg.n_kept
+            keep_step, state, kept_iters
         )
 
+        n_phi_updates = sum(
+            1
+            for i in range(cfg.n_burn_in, cfg.n_samples)
+            if i % cfg.phi_update_every == 0
+        )
         param_grid = quantile_grid(param_draws, cfg.n_quantiles)
         w_grid = quantile_grid(w_draws, cfg.n_quantiles)
         return SubsetResult(
             param_grid=param_grid,
             w_grid=w_grid,
-            phi_accept_rate=state.phi_accept / float(cfg.n_kept),
+            phi_accept_rate=state.phi_accept / float(max(n_phi_updates, 1)),
             param_samples=param_draws,
             w_samples=w_draws,
         )
+
+
+# Backwards-compatible name: the probit path is the default link.
+SpatialProbitGP = SpatialGPSampler

@@ -1,0 +1,64 @@
+"""Fixed-iteration batched conjugate gradient.
+
+The u-update's Matheron draw needs one solve against (R + D) per
+component per MCMC iteration (models/probit_gp.py step 4). A dense
+Cholesky costs O(m^3) with low MXU utilization (sequential panel
+factorization); CG with the matvec expressed through the carried
+Cholesky factor of R — x -> L (L^T x) + d * x, two triangular matmuls
+— costs O(iters * m^2) of pure batched matmul, which at the n=1M /
+K=256 target sizes (m ~ 3906) is several times cheaper and rides the
+MXU at near peak. (R + D) is well-conditioned (positive diagonal D of
+order 1 added to a unit-diagonal correlation), so a fixed, static
+iteration count reaches fp32-level residuals — no data-dependent
+stopping, jit/vmap-friendly.
+
+This is the standard "CG sampling" trick for GP Gibbs updates; the
+solver is exposed generically (caller supplies the matvec).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def cg_solve(
+    matvec: Callable[[jnp.ndarray], jnp.ndarray],
+    b: jnp.ndarray,
+    n_iters: int = 64,
+    diag: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Solve A x = b with `n_iters` (P)CG steps (A SPD via `matvec`).
+
+    b: (..., m) — batched over leading dims (matvec must broadcast).
+    diag: optional (..., m) Jacobi preconditioner (diagonal of A) —
+    essential when D carries the huge padded-row pseudo-variances,
+    which would otherwise wreck the condition number. Zero initial
+    guess, static iteration count; eps-guarded divisions keep the
+    recurrence finite after convergence stalls.
+    """
+    eps = jnp.asarray(1e-20, b.dtype)
+    inv_diag = None if diag is None else 1.0 / jnp.maximum(diag, eps)
+
+    def precond(r):
+        return r if inv_diag is None else inv_diag * r
+
+    def body(carry, _):
+        x, r, p, rz = carry
+        ap = matvec(p)
+        alpha = rz / (jnp.sum(p * ap, axis=-1, keepdims=True) + eps)
+        x = x + alpha * p
+        r = r - alpha * ap
+        z = precond(r)
+        rz_new = jnp.sum(r * z, axis=-1, keepdims=True)
+        beta = rz_new / (rz + eps)
+        p = z + beta * p
+        return (x, r, p, rz_new), None
+
+    x0 = jnp.zeros_like(b)
+    z0 = precond(b)
+    rz0 = jnp.sum(b * z0, axis=-1, keepdims=True)
+    (x, _, _, _), _ = lax.scan(body, (x0, b, z0, rz0), None, length=n_iters)
+    return x

@@ -1,0 +1,69 @@
+"""Pólya-Gamma sampling for the logit-link spatial GLM.
+
+The reference fits a **logit**-link multivariate spatial GLM
+(MetaKriging_BinaryResponse.R:80-84, and the logistic inverse link at
+:160) by adaptive Metropolis — per-element random-walk updates of the
+latent surface with batch tuning (:61-62,83). The TPU-native logit
+path instead uses Pólya-Gamma data augmentation (Polson–Scott–Windle):
+with omega ~ PG(weight, eta) each binomial-logit observation becomes a
+Gaussian pseudo-observation z = kappa/omega of precision omega
+(kappa = y - weight/2), so beta, the component GPs and the
+coregionalization matrix keep exactly the same conjugate updates as
+the probit path — no tuning, no accept/reject, static control flow.
+
+PG(b, c) is sampled from its defining infinite series
+    omega = (1 / (2 pi^2)) * sum_k g_k / ((k - 1/2)^2 + a^2),
+    g_k ~ Gamma(b, 1),  a = c / (2 pi),
+truncated at a static number of terms with the dropped tail replaced
+by its closed-form mean — fully vectorized, fixed shapes, no
+rejection loops (the classic Devroye sampler is rejection-based and
+branch-heavy, hostile to jit/vmap). With 64 terms the relative bias
+of the first two moments is < 1e-3 across the relevant |c| range.
+
+Check: E[PG(b, c)] = (b / 2c) tanh(c / 2), recovered exactly by the
+series since sum_k 1/((k-1/2)^2 + a^2) = (pi^2 / c) tanh(c / 2).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_TWO_PI_SQ = 2.0 * jnp.pi * jnp.pi
+
+
+def sample_pg(
+    key: jax.Array,
+    b: int,
+    c: jnp.ndarray,
+    n_terms: int = 64,
+) -> jnp.ndarray:
+    """Draw omega ~ PG(b, c) elementwise over c's shape.
+
+    b must be a static Python int (the binomial trial count /
+    reference `weight`); c is the linear predictor (any shape).
+    """
+    dtype = c.dtype
+    c = jnp.abs(c)  # PG(b, c) depends on c only through c^2
+    a = c / (2.0 * jnp.pi)
+    k = jnp.arange(1, n_terms + 1, dtype=dtype)
+    denom_shape = (n_terms,) + (1,) * c.ndim
+    k_half = (k - 0.5).reshape(denom_shape)
+    denom = k_half * k_half + a[None] * a[None]
+    g = jax.random.gamma(key, float(b), (n_terms,) + c.shape, dtype)
+    series = jnp.sum(g / denom, axis=0)
+    # Mean of the dropped tail: (b / 2pi^2) * sum_{k>K} 1/((k-1/2)^2+a^2)
+    # ~ (b / 2pi^2) * (1/a) * arctan(a / K)  (integral tail; the arctan
+    # form avoids the pi/2 - arctan cancellation and has the correct
+    # a -> 0 limit 1/K).
+    a_safe = jnp.maximum(a, 1e-12)
+    tail = float(b) * jnp.arctan(a_safe / n_terms) / a_safe
+    return (series + tail) / _TWO_PI_SQ
+
+
+def pg_mean(b: float, c: jnp.ndarray) -> jnp.ndarray:
+    """E[PG(b, c)] = (b / 2c) tanh(c / 2), with the c -> 0 limit b/4."""
+    c = jnp.abs(c)
+    small = c < 1e-4
+    c_safe = jnp.where(small, 1.0, c)
+    return jnp.where(small, b / 4.0, b * jnp.tanh(c_safe / 2.0) / (2.0 * c_safe))

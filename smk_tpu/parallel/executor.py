@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from smk_tpu.models.probit_gp import SpatialProbitGP, SubsetData, SubsetResult
+from smk_tpu.models.probit_gp import SpatialGPSampler, SubsetData, SubsetResult
 from smk_tpu.parallel.partition import Partition
 
 # vmap axes for SubsetData: subset-local fields batch on axis 0, test
@@ -53,7 +53,7 @@ def _stacked_data(
 
 
 def fit_subsets_vmap(
-    model: SpatialProbitGP,
+    model: SpatialGPSampler,
     part: Partition,
     coords_test: jnp.ndarray,
     x_test: jnp.ndarray,
@@ -117,7 +117,7 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "subsets") -> Mesh:
 
 
 def fit_subsets_sharded(
-    model: SpatialProbitGP,
+    model: SpatialGPSampler,
     part: Partition,
     coords_test: jnp.ndarray,
     x_test: jnp.ndarray,

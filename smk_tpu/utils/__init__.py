@@ -3,7 +3,7 @@
 
 from smk_tpu.utils.diagnostics import effective_sample_size, split_rhat
 from smk_tpu.utils.checkpoint import save_pytree, load_pytree
-from smk_tpu.utils.tracing import phase_timer, PhaseTimes
+from smk_tpu.utils.tracing import phase_timer, PhaseTimes, device_sync
 
 __all__ = [
     "effective_sample_size",
@@ -12,4 +12,5 @@ __all__ = [
     "load_pytree",
     "phase_timer",
     "PhaseTimes",
+    "device_sync",
 ]

@@ -11,7 +11,31 @@ from __future__ import annotations
 import contextlib
 import time
 from dataclasses import dataclass, field
-from typing import Dict, Iterator
+from typing import Any, Dict, Iterator
+
+import jax
+import numpy as np
+
+
+def device_sync(tree: Any) -> None:
+    """Force real completion of every array in ``tree``.
+
+    ``jax.block_until_ready`` alone does not actually wait on
+    remote-tunnel TPU backends (dispatch returns a future the local
+    runtime considers "ready"); fetching one element to the host does,
+    because the slice depends on the producing computation. Wall-clock
+    timers must call this, or they time the dispatch, not the work.
+    """
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if not hasattr(leaf, "dtype"):
+            continue
+        if jax.dtypes.issubdtype(leaf.dtype, jax.dtypes.prng_key):
+            leaf = jax.random.key_data(leaf)
+        jax.block_until_ready(leaf)
+        if leaf.ndim > 0:
+            np.asarray(leaf.ravel()[:1])
+        else:
+            np.asarray(leaf)
 
 
 @dataclass

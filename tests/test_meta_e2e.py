@@ -63,13 +63,18 @@ class TestPipeline:
         assert np.isfinite(np.asarray(res.param_grid)).all()
         assert (np.diff(np.asarray(res.param_grid), axis=0) >= -1e-5).all()
 
-    def test_logit_link_rejected_for_now(self):
+    def test_logit_link_pipeline(self):
+        """The reference's own link (R:160), via Pólya-Gamma."""
         y, x, coords, ct, xt = _toy_problem(seed=2)
-        with pytest.raises(NotImplementedError):
-            fit_meta_kriging(
-                jax.random.key(2), y, x, coords, ct, xt,
-                config=SMKConfig(link="logit"),
-            )
+        cfg = SMKConfig(
+            n_subsets=4, n_samples=120, burn_in_frac=0.5, link="logit"
+        )
+        res = fit_meta_kriging(
+            jax.random.key(2), y, x, coords, ct, xt, config=cfg
+        )
+        p_all = np.asarray(res.p_samples)
+        assert np.isfinite(np.asarray(res.param_grid)).all()
+        assert (p_all >= 0).all() and (p_all <= 1).all()
 
 
 class TestShardedExecution:

@@ -1,0 +1,41 @@
+"""Pólya-Gamma sampler moments vs closed forms."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from smk_tpu.ops.polya_gamma import pg_mean, sample_pg
+
+
+@pytest.mark.parametrize("b", [1, 4])
+@pytest.mark.parametrize("c", [0.0, 0.5, 2.0, 8.0])
+def test_pg_moments(b, c):
+    key = jax.random.key(0)
+    d = np.asarray(sample_pg(key, b, jnp.full((60_000,), c, jnp.float32)))
+    m_true = float(pg_mean(b, jnp.float32(c)))
+    if c > 0:
+        v_true = b * (np.sinh(c) - c) / (4 * c**3 * np.cosh(c / 2) ** 2)
+    else:
+        v_true = b / 24.0
+    np.testing.assert_allclose(d.mean(), m_true, rtol=2e-2)
+    np.testing.assert_allclose(d.var(), v_true, rtol=6e-2)
+    assert (d > 0).all()
+
+
+def test_pg_mean_closed_form():
+    c = jnp.asarray([1e-8, 0.1, 1.0, 5.0], jnp.float32)
+    got = np.asarray(pg_mean(1.0, c))
+    want = np.where(
+        np.asarray(c) < 1e-4,
+        0.25,
+        np.tanh(np.asarray(c) / 2) / (2 * np.asarray(c)),
+    )
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_pg_sign_symmetry():
+    key = jax.random.key(1)
+    a = sample_pg(key, 1, jnp.full((100,), 2.0, jnp.float32))
+    b = sample_pg(key, 1, jnp.full((100,), -2.0, jnp.float32))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))

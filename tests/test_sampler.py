@@ -127,6 +127,38 @@ class TestSingleSubsetRecovery:
         # run must stay in the same statistical regime
         np.testing.assert_allclose(med_pad, med_ref, atol=1.2)
 
+    def test_logit_link_recovers_slope(self):
+        """Pólya-Gamma logit sampler: synthetic logistic spatial field,
+        slope recovered within its 95% CI."""
+        kc, ku, ky, kx = jax.random.split(jax.random.key(21), 4)
+        m = 200
+        coords = jax.random.uniform(kc, (m, 2))
+        dist = pairwise_distance(coords)
+        l = jittered_cholesky(exponential(dist, 6.0), 1e-5)
+        w = l @ jax.random.normal(ku, (m,))
+        x = jnp.concatenate(
+            [jnp.ones((m, 1, 1)), jax.random.normal(kx, (m, 1, 1))], -1
+        )
+        beta_true = jnp.asarray([[0.7, -0.9]])
+        eta = jnp.einsum("mqp,qp->mq", x, beta_true) + w[:, None]
+        prob = 1.0 / (1.0 + jnp.exp(-eta))
+        y = (jax.random.uniform(ky, prob.shape) < prob).astype(jnp.float32)
+        data = SubsetData(
+            coords=coords, x=x, y=y, mask=jnp.ones((m,), jnp.float32),
+            coords_test=coords[:4] + 0.01, x_test=x[:4],
+        )
+        cfg = SMKConfig(
+            n_subsets=1, n_samples=800, burn_in_frac=0.5, link="logit"
+        )
+        model = SpatialProbitGP(cfg, weight=1)
+        st = model.init_state(jax.random.key(8), data)
+        res = jax.jit(model.run)(data, st)
+        ps = np.asarray(res.param_samples)
+        assert np.isfinite(ps).all()
+        lo, hi = np.quantile(ps[:, 1], 0.025), np.quantile(ps[:, 1], 0.975)
+        assert lo < -0.9 < hi or abs(np.median(ps[:, 1]) + 0.9) < 0.45
+        assert (ps[:, 2] > 0).all()  # K00 positive
+
     def test_binomial_weight(self):
         data, _ = synthetic_subset(
             jax.random.key(9), 100, 1, 2, [6.0], [[1.0]], [[0.5, -0.5]]
