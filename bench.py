@@ -73,11 +73,13 @@ def main():
         y[:n], x[:n], coords[:n], coords[n:], x[n:],
     )
 
-    # Scaling-regime solver settings (both validated to give the same
-    # posterior as the exact defaults — tests/test_sampler.py): the
-    # u-update solved by 48-step preconditioned CG through the carried
-    # Cholesky factor (rel. residual ~4e-6 at m=1000), and the phi MH
-    # (the one remaining O(m^3) factorization) run every 2nd sweep.
+    # Scaling-regime solver settings — this exact combination
+    # (u_solver="cg", cg_iters=48, phi_update_every=2) is validated to
+    # target the same posterior as the exact defaults by
+    # tests/test_sampler.py::TestSolverEquivalence (shared-seed chains,
+    # distribution-level comparison): the u-update solved by 48-step
+    # preconditioned CG through the carried Cholesky factor, and the
+    # phi MH (the one remaining O(m^3) factorization) every 2nd sweep.
     cfg = SMKConfig(
         n_subsets=k,
         n_samples=n_samples,

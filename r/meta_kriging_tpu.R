@@ -40,9 +40,16 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
                                 n.samples = 5000, burn.in = 0.75,
                                 cov.model = "exponential",
                                 combiner = "wasserstein_mean",
+                                link = c("probit", "logit"),
                                 backend = c("tpu", "cpu"),
                                 seed = 0L,
                                 python_path = NULL) {
+  # link: the reference workflow is logit (spMvGLM binomial fit,
+  # 1/(1+exp(-eta)) at MetaKriging_BinaryResponse.R:160); the TPU
+  # default is the exact Albert–Chib probit sampler. Users porting the
+  # reference side-by-side should pass link = "logit" — coefficient
+  # scales differ between the links by ~1.7x.
+  link <- match.arg(link)
   backend <- match.arg(backend)
   if (!requireNamespace("reticulate", quietly = TRUE)) {
     stop("the TPU backend needs the 'reticulate' package")
@@ -73,7 +80,8 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
     n_samples = as.integer(n.samples),
     burn_in_frac = burn.in,
     cov_model = cov.model,
-    combiner = combiner
+    combiner = combiner,
+    link = link
   )
   res <- smk$fit_meta_kriging(
     jax$random$key(as.integer(seed)),

@@ -141,6 +141,17 @@ def fit_meta_kriging(
     times = PhaseTimes()
     k_part, k_fit, k_resample = jax.random.split(key, 3)
 
+    # Everything downstream computes in cfg.dtype (float64 requires
+    # jax_enable_x64; otherwise JAX silently demotes, so fail loudly).
+    dt = jnp.dtype(cfg.dtype)
+    if dt == jnp.float64 and not jax.config.read("jax_enable_x64"):
+        raise ValueError(
+            "config.dtype='float64' requires jax_enable_x64 to be set"
+        )
+    y, x, coords, coords_test, x_test = (
+        jnp.asarray(a, dt) for a in (y, x, coords, coords_test, x_test)
+    )
+
     with phase_timer(times, "partition"):
         part = random_partition(k_part, y, x, coords, cfg.n_subsets)
         device_sync(part.y)

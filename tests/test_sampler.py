@@ -64,10 +64,13 @@ class TestSingleSubsetRecovery:
         lo, hi = np.quantile(ps, 0.025, 0), np.quantile(ps, 0.975, 0)
         # slope is well identified; intercept/K/phi get sanity bounds
         assert lo[1] < -0.6 < hi[1]
-        assert 0.05 < np.median(ps[:, 2]) < 8.0  # K00, true 1.0
+        # K00 truth is 1.0; m=200 leaves real posterior spread but the
+        # median must land the right order of magnitude
+        assert 0.25 < np.median(ps[:, 2]) < 3.5
         assert 4.0 <= np.median(ps[:, 3]) <= 12.0  # phi within prior
-        # phi MH should actually move
-        assert 0.05 < float(res.phi_accept_rate[0]) < 0.99
+        # Robbins–Monro burn-in adaptation must land acceptance near
+        # the 0.43 target (reference R:83) without hand tuning
+        assert 0.25 < float(res.phi_accept_rate[0]) < 0.62
 
     def test_q2_shapes_and_sanity(self):
         a_true = [[1.0, 0.0], [0.5, 0.8]]
@@ -92,8 +95,19 @@ class TestSingleSubsetRecovery:
         assert (np.diff(np.asarray(res.param_grid), axis=0) >= -1e-5).all()
 
     def test_padded_rows_are_inert(self):
-        """Padded (mask=0) rows must not influence the posterior:
-        their latents revert to the prior and likelihood terms vanish."""
+        """Padded (mask=0) rows must not influence the posterior.
+
+        With masked_correlation, pad latents are independent N(0,1)
+        noise: their likelihood weight is zero, their phi-loglik
+        contribution cancels in the MH ratio, and their kriging
+        cross-covariance rows are zeroed. The padded and unpadded runs
+        consume different PRNG stream shapes so the chains are not
+        identical draws — the check is statistical: every parameter's
+        posterior median must agree within one posterior sd, and the
+        95% intervals must overlap. Dropping the mask from the
+        likelihood (24 pad rows of y=0, x=0 at m=80) shifts the
+        intercept and phi by several sd and fails this.
+        """
         data, _ = synthetic_subset(
             jax.random.key(5), 80, 1, 2, [6.0], [[1.0]], [[0.5, -0.5]]
         )
@@ -112,7 +126,7 @@ class TestSingleSubsetRecovery:
             coords_test=data.coords_test,
             x_test=data.x_test,
         )
-        cfg = SMKConfig(n_subsets=1, n_samples=300, burn_in_frac=0.5)
+        cfg = SMKConfig(n_subsets=1, n_samples=600, burn_in_frac=0.5)
         model = SpatialProbitGP(cfg, weight=1)
         res_pad = jax.jit(model.run)(
             padded, model.init_state(jax.random.key(1), padded)
@@ -120,12 +134,17 @@ class TestSingleSubsetRecovery:
         res_ref = jax.jit(model.run)(
             data, model.init_state(jax.random.key(1), data)
         )
-        med_pad = np.median(np.asarray(res_pad.param_samples), 0)
-        med_ref = np.median(np.asarray(res_ref.param_samples), 0)
-        assert np.isfinite(med_pad).all()
-        # different PRNG stream shapes -> not identical, but the padded
-        # run must stay in the same statistical regime
-        np.testing.assert_allclose(med_pad, med_ref, atol=1.2)
+        ps_pad = np.asarray(res_pad.param_samples)
+        ps_ref = np.asarray(res_ref.param_samples)
+        assert np.isfinite(ps_pad).all()
+        med_pad, med_ref = np.median(ps_pad, 0), np.median(ps_ref, 0)
+        sd = np.maximum(ps_ref.std(0), 1e-3)
+        assert (np.abs(med_pad - med_ref) / sd < 1.0).all(), (
+            med_pad, med_ref, sd
+        )
+        lo_p, hi_p = np.quantile(ps_pad, 0.025, 0), np.quantile(ps_pad, 0.975, 0)
+        lo_r, hi_r = np.quantile(ps_ref, 0.025, 0), np.quantile(ps_ref, 0.975, 0)
+        assert (np.maximum(lo_p, lo_r) <= np.minimum(hi_p, hi_r)).all()
 
     def test_logit_link_recovers_slope(self):
         """Pólya-Gamma logit sampler: synthetic logistic spatial field,
@@ -159,6 +178,38 @@ class TestSingleSubsetRecovery:
         assert lo < -0.9 < hi or abs(np.median(ps[:, 1]) + 0.9) < 0.45
         assert (ps[:, 2] > 0).all()  # K00 positive
 
+    def test_probit_and_logit_agree_on_prediction(self):
+        """Sanity cross-check between the two links: fit the same
+        binary field with each; the posterior predictive p(y=1) at the
+        test sites is a link-free quantity and must agree to within
+        modeling slack (the links differ in tail shape, not in what
+        field they fit)."""
+        data, _ = synthetic_subset(
+            jax.random.key(31), 200, 1, 2, [6.0], [[1.0]], [[0.5, -0.5]]
+        )
+        preds = {}
+        for link in ("probit", "logit"):
+            cfg = SMKConfig(
+                n_subsets=1, n_samples=600, burn_in_frac=0.5, link=link
+            )
+            model = SpatialProbitGP(cfg, weight=1)
+            res = jax.jit(model.run)(
+                data, model.init_state(jax.random.key(13), data)
+            )
+            # latent + fixed effect -> predictive probability draws
+            xb = np.einsum(
+                "tqp,sqp->stq",
+                np.asarray(data.x_test),
+                np.asarray(res.param_samples)[:, :2].reshape(-1, 1, 2),
+            ).reshape(res.w_samples.shape[0], -1)
+            eta = xb + np.asarray(res.w_samples)
+            if link == "probit":
+                p = np.asarray(jax.scipy.special.ndtr(jnp.asarray(eta)))
+            else:
+                p = 1.0 / (1.0 + np.exp(-eta))
+            preds[link] = p.mean(0)
+        assert np.abs(preds["probit"] - preds["logit"]).max() < 0.2
+
     def test_binomial_weight(self):
         data, _ = synthetic_subset(
             jax.random.key(9), 100, 1, 2, [6.0], [[1.0]], [[0.5, -0.5]]
@@ -173,3 +224,57 @@ class TestSingleSubsetRecovery:
         )
         assert np.isfinite(np.asarray(res.param_samples)).all()
         assert np.isfinite(np.asarray(res.w_samples)).all()
+
+
+def _posteriors_agree(ps_a, ps_b, max_sd=0.75):
+    """Distribution-level agreement: medians within max_sd posterior
+    sds and overlapping 95% intervals, per parameter column."""
+    med_a, med_b = np.median(ps_a, 0), np.median(ps_b, 0)
+    sd = np.maximum(0.5 * (ps_a.std(0) + ps_b.std(0)), 1e-3)
+    assert (np.abs(med_a - med_b) / sd < max_sd).all(), (med_a, med_b, sd)
+    lo_a, hi_a = np.quantile(ps_a, 0.025, 0), np.quantile(ps_a, 0.975, 0)
+    lo_b, hi_b = np.quantile(ps_b, 0.025, 0), np.quantile(ps_b, 0.975, 0)
+    assert (np.maximum(lo_a, lo_b) <= np.minimum(hi_a, hi_b)).all()
+
+
+class TestSolverEquivalence:
+    """The benchmark's scaling-regime settings (bench.py: u_solver=cg,
+    cg_iters=48, phi_update_every=2) must target the same posterior as
+    the exact defaults — this covers the exact env-var config of
+    BENCH_r*.json (chains share seeds, so differences isolate the
+    solver/schedule)."""
+
+    def _fit(self, data, **overrides):
+        cfg = SMKConfig(
+            n_subsets=1, n_samples=800, burn_in_frac=0.5, **overrides
+        )
+        model = SpatialProbitGP(cfg, weight=1)
+        st = model.init_state(jax.random.key(17), data)
+        return jax.jit(model.run)(data, st)
+
+    @pytest.fixture(scope="class")
+    def shared(self):
+        data, _ = synthetic_subset(
+            jax.random.key(23), 160, 1, 2, [6.0], [[1.0]], [[0.6, -0.7]]
+        )
+        exact = self._fit(data)
+        return data, np.asarray(exact.param_samples)
+
+    def test_cg_matches_chol_posterior(self, shared):
+        data, ps_exact = shared
+        res = self._fit(data, u_solver="cg", cg_iters=48)
+        _posteriors_agree(ps_exact, np.asarray(res.param_samples))
+
+    def test_phi_update_every_2_matches(self, shared):
+        data, ps_exact = shared
+        res = self._fit(data, phi_update_every=2)
+        _posteriors_agree(ps_exact, np.asarray(res.param_samples))
+
+    def test_bench_config_matches(self, shared):
+        """The full benchmark combination, exactly as bench.py sets it."""
+        data, ps_exact = shared
+        res = self._fit(
+            data, u_solver="cg", cg_iters=48, phi_update_every=2
+        )
+        _posteriors_agree(ps_exact, np.asarray(res.param_samples))
+        assert 0.2 < float(res.phi_accept_rate[0]) < 0.7

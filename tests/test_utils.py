@@ -65,6 +65,7 @@ class TestCheckpoint:
             chol_r=jnp.broadcast_to(jnp.eye(10), (2, 10, 10)),
             key=jax.random.key(0),
             phi_accept=jnp.zeros((2,)),
+            phi_log_step=jnp.full((2,), -0.7),
         )
         path = os.path.join(tmp_path, "ckpt.npz")
         save_pytree(path, st)
