@@ -130,12 +130,19 @@ def fit_meta_kriging(
     sharded: bool = False,
     mesh=None,
     chunk_size: Optional[int] = None,
+    checkpoint_path: Optional[str] = None,
+    checkpoint_every: int = 500,
 ) -> MetaKrigingResult:
     """Full spatial-meta-kriging pipeline.
 
     y: (n, q) binary/binomial counts; x: (n, q, p) designs;
     coords: (n, d); coords_test: (t, d); x_test: (t, q, p);
     weight: binomial trial count (reference `weight`, R:53,81).
+    checkpoint_path: if set, the subset fits run through the
+    checkpointed executor (parallel/recovery.py) — sampler state +
+    kept draws are saved every `checkpoint_every` iterations and an
+    interrupted call resumes from the file (mutually exclusive with
+    `sharded` for now).
     """
     cfg = config or SMKConfig()
     times = PhaseTimes()
@@ -165,7 +172,19 @@ def fit_meta_kriging(
 
     model = SpatialGPSampler(cfg, weight=weight)
     with phase_timer(times, "subset_fits"):
-        if sharded:
+        if checkpoint_path is not None:
+            if sharded:
+                raise ValueError(
+                    "checkpoint_path and sharded are mutually exclusive"
+                )
+            from smk_tpu.parallel.recovery import fit_subsets_checkpointed
+
+            results = fit_subsets_checkpointed(
+                model, part, coords_test, x_test, k_fit, beta_init,
+                checkpoint_path=checkpoint_path,
+                chunk_iters=checkpoint_every,
+            )
+        elif sharded:
             results = fit_subsets_sharded(
                 model, part, coords_test, x_test, k_fit, beta_init,
                 mesh=mesh, chunk_size=chunk_size,

@@ -454,33 +454,67 @@ class SpatialGPSampler:
 
     def _run(self, data, init_state):
         cfg = self.config
-        dtype = data.x.dtype
+        state = self._burn_in(data, init_state)
+        state, (param_draws, w_draws) = self._sample_chunk(
+            data, state, jnp.asarray(cfg.n_burn_in), cfg.n_kept
+        )
+        return self.finalize(state, param_draws, w_draws)
 
+    # -- resumable pieces (used by run() and the checkpointed executor,
+    # parallel/resume.py; chunking the sampling scan changes nothing:
+    # the PRNG sequence lives in the carried state) -------------------
+    def _consts(self, data):
         # Per-subset constants, built once and closed over by the scan
         # body (distances never change; only the phi decay does).
-        dist = pairwise_distance(data.coords)
-        dist_cross = cross_distance(data.coords, data.coords_test)
-        dist_test = pairwise_distance(data.coords_test)
-        consts = (dist, dist_cross, dist_test)
+        return (
+            pairwise_distance(data.coords),
+            cross_distance(data.coords, data.coords_test),
+            pairwise_distance(data.coords_test),
+        )
 
-        burn_step = lambda st, it: (
+    def burn_in(self, data: SubsetData, init_state: SamplerState):
+        """Burn-in scan; the returned state starts the sampling phase
+        (acceptance counter reset so reported rates are post-burn-in)."""
+        with jax.default_matmul_precision(self.config.matmul_precision):
+            return self._burn_in(data, init_state)
+
+    def _burn_in(self, data, init_state):
+        consts = self._consts(data)
+        step = lambda st, it: (
             self._gibbs_step(data, consts, st, it, collect=False)[0],
             None,
         )
-        keep_step = lambda st, it: self._gibbs_step(
+        state, _ = lax.scan(
+            step, init_state, jnp.arange(self.config.n_burn_in)
+        )
+        return state._replace(phi_accept=jnp.zeros_like(state.phi_accept))
+
+    def sample_chunk(
+        self,
+        data: SubsetData,
+        state: SamplerState,
+        start_it,
+        n_iters: int,
+    ):
+        """Collecting scan over iterations [start_it, start_it+n_iters).
+
+        start_it may be traced (resume passes it dynamically); n_iters
+        is static. Returns (state, (param_draws, w_draws)).
+        """
+        with jax.default_matmul_precision(self.config.matmul_precision):
+            return self._sample_chunk(data, state, start_it, n_iters)
+
+    def _sample_chunk(self, data, state, start_it, n_iters):
+        consts = self._consts(data)
+        step = lambda st, it: self._gibbs_step(
             data, consts, st, it, collect=True
         )
+        iters = start_it + jnp.arange(n_iters)
+        return lax.scan(step, state, iters)
 
-        state, _ = lax.scan(
-            burn_step, init_state, jnp.arange(cfg.n_burn_in)
-        )
-        # reset acceptance counter so the reported rate is post-burn-in
-        state = state._replace(phi_accept=jnp.zeros_like(state.phi_accept))
-        kept_iters = jnp.arange(cfg.n_burn_in, cfg.n_samples)
-        state, (param_draws, w_draws) = lax.scan(
-            keep_step, state, kept_iters
-        )
-
+    def finalize(self, state, param_draws, w_draws) -> SubsetResult:
+        """Compression + diagnostics over the full kept-draw arrays."""
+        cfg = self.config
         n_phi_updates = sum(
             1
             for i in range(cfg.n_burn_in, cfg.n_samples)

@@ -13,12 +13,20 @@ from smk_tpu.parallel.combine import (
     weiszfeld_median,
     combine_quantile_grids,
 )
+from smk_tpu.parallel.recovery import (
+    fit_subsets_checkpointed,
+    find_failed_subsets,
+    rerun_subsets,
+)
 
 __all__ = [
     "random_partition",
     "Partition",
     "fit_subsets_vmap",
     "fit_subsets_sharded",
+    "fit_subsets_checkpointed",
+    "find_failed_subsets",
+    "rerun_subsets",
     "make_mesh",
     "wasserstein_barycenter",
     "weiszfeld_median",

@@ -1,0 +1,209 @@
+"""Checkpoint/resume execution and failed-shard recovery.
+
+The reference persists nothing: MCMC state lives only in PSOCK worker
+memory, a dead worker aborts the whole ``foreach`` fan-out, and the
+leaked cluster is the opposite of recovery
+(MetaKriging_BinaryResponse.R:102-114, SURVEY.md §3.5, §5.3-5.4).
+Here both durability subsystems are real:
+
+- ``fit_subsets_checkpointed`` runs the K-subset fan-out with the
+  sampling scan chunked over iterations; after burn-in and after every
+  chunk, the stacked sampler state + kept draws land in one atomic
+  ``.npz`` checkpoint. Killed at any point, the same call resumes from
+  the last chunk boundary and produces results identical to an
+  uninterrupted run — chunking cannot change the chain because the
+  PRNG sequence lives in the carried ``SamplerState.key``.
+- ``find_failed_subsets`` / ``rerun_subsets`` recover single shards:
+  each subset fit is a pure function of (data slice, per-subset key),
+  so recovery re-runs exactly the failed shard(s) under their original
+  keys and scatters the results back into the gathered pytree.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from smk_tpu.models.probit_gp import (
+    SpatialGPSampler,
+    SubsetData,
+    SubsetResult,
+    n_params,
+)
+from smk_tpu.parallel.executor import _DATA_AXES, _stacked_data
+from smk_tpu.parallel.partition import Partition
+from smk_tpu.utils.checkpoint import load_pytree, save_pytree
+
+
+def _init_states(model, keys, data, beta_init):
+    return jax.vmap(
+        lambda kk, d: model.init_state(kk, d, beta_init),
+        in_axes=(0, _DATA_AXES),
+    )(keys, data)
+
+
+def fit_subsets_checkpointed(
+    model: SpatialGPSampler,
+    part: Partition,
+    coords_test: jnp.ndarray,
+    x_test: jnp.ndarray,
+    key: jax.Array,
+    beta_init: Optional[jnp.ndarray] = None,
+    *,
+    checkpoint_path: str,
+    chunk_iters: int = 500,
+    stop_after_chunks: Optional[int] = None,
+) -> Optional[SubsetResult]:
+    """K-subset fan-out with periodic checkpointing and resume.
+
+    If ``checkpoint_path`` exists, the run resumes from it (the caller
+    must pass the same data/config/key — config identity is verified
+    from recorded metadata). ``stop_after_chunks`` ends the run early
+    after that many sampling chunks (returning None with the
+    checkpoint on disk) — the hook the kill-and-resume test uses.
+    """
+    cfg = model.config
+    k = part.n_subsets
+    data = _stacked_data(part, coords_test, x_test)
+    keys = jax.random.split(key, k)
+    init = _init_states(model, keys, data, beta_init)
+
+    m, q, p = part.x.shape[1:]
+    d_par = n_params(q, p)
+    d_w = coords_test.shape[0] * q
+    dtype = part.x.dtype
+
+    def empty_draws():
+        return (
+            jnp.zeros((k, 0, d_par), dtype),
+            jnp.zeros((k, 0, d_w), dtype),
+        )
+
+    meta = np.asarray(
+        [cfg.n_samples, cfg.n_burn_in, k, d_par, d_w], np.int64
+    )
+    like = {
+        "state": init,
+        "param_draws": empty_draws()[0],
+        "w_draws": empty_draws()[1],
+        "meta": meta,
+    }
+
+    if os.path.exists(checkpoint_path):
+        ckpt = load_pytree(checkpoint_path, like)
+        if not np.array_equal(np.asarray(ckpt["meta"]), meta):
+            raise ValueError(
+                f"checkpoint {checkpoint_path} was written for a "
+                f"different run: meta {np.asarray(ckpt['meta'])} vs "
+                f"expected {meta}"
+            )
+        # leaves arrive as numpy (PRNG keys re-wrapped by load_pytree);
+        # jax consumes them directly
+        state = ckpt["state"]
+        param_draws = jnp.asarray(ckpt["param_draws"], dtype)
+        w_draws = jnp.asarray(ckpt["w_draws"], dtype)
+    else:
+        burn = jax.jit(jax.vmap(model.burn_in, in_axes=(_DATA_AXES, 0)))
+        state = burn(data, init)
+        param_draws, w_draws = empty_draws()
+        save_pytree(
+            checkpoint_path,
+            {
+                "state": state,
+                "param_draws": param_draws,
+                "w_draws": w_draws,
+                "meta": meta,
+            },
+        )
+
+    chunk_fns = {}
+
+    def chunk_fn(n: int):
+        if n not in chunk_fns:
+            chunk_fns[n] = jax.jit(
+                jax.vmap(
+                    lambda d_, s_, t_: model.sample_chunk(d_, s_, t_, n),
+                    in_axes=(_DATA_AXES, 0, None),
+                )
+            )
+        return chunk_fns[n]
+
+    it_next = cfg.n_burn_in + param_draws.shape[1]
+    chunks_done = 0
+    while it_next < cfg.n_samples:
+        n = min(chunk_iters, cfg.n_samples - it_next)
+        state, (pd, wd) = chunk_fn(n)(data, state, jnp.asarray(it_next))
+        param_draws = jnp.concatenate([param_draws, pd], axis=1)
+        w_draws = jnp.concatenate([w_draws, wd], axis=1)
+        it_next += n
+        save_pytree(
+            checkpoint_path,
+            {
+                "state": state,
+                "param_draws": param_draws,
+                "w_draws": w_draws,
+                "meta": meta,
+            },
+        )
+        chunks_done += 1
+        if (
+            stop_after_chunks is not None
+            and chunks_done >= stop_after_chunks
+            and it_next < cfg.n_samples
+        ):
+            return None
+
+    finalize = jax.jit(jax.vmap(model.finalize))
+    return finalize(state, param_draws, w_draws)
+
+
+def find_failed_subsets(results: SubsetResult) -> np.ndarray:
+    """Indices of shards whose compressed grids contain non-finite
+    values — the framework's failure-detection hook (a pure-function
+    fit can only fail numerically, and it fails loudly as NaN/inf)."""
+    pg = np.asarray(results.param_grid)
+    wg = np.asarray(results.w_grid)
+    ok = np.isfinite(pg).all(axis=(1, 2)) & np.isfinite(wg).all(axis=(1, 2))
+    return np.where(~ok)[0]
+
+
+def rerun_subsets(
+    model: SpatialGPSampler,
+    part: Partition,
+    coords_test: jnp.ndarray,
+    x_test: jnp.ndarray,
+    key: jax.Array,
+    results: SubsetResult,
+    subset_ids: Sequence[int],
+    beta_init: Optional[jnp.ndarray] = None,
+) -> SubsetResult:
+    """Re-run only ``subset_ids`` and scatter into ``results``.
+
+    ``key`` must be the same fan-out key passed to the original
+    ``fit_subsets_*`` call: per-subset keys are re-derived by the same
+    split, so a re-run shard reproduces its original chain exactly
+    (the reference loses the entire job instead, SURVEY.md §5.3).
+    """
+    ids = jnp.asarray(subset_ids, jnp.int32)
+    keys = jax.random.split(key, part.n_subsets)[ids]
+    data = SubsetData(
+        coords=part.coords[ids],
+        x=part.x[ids],
+        y=part.y[ids],
+        mask=part.mask[ids],
+        coords_test=coords_test,
+        x_test=x_test,
+    )
+    init = _init_states(model, keys, data, beta_init)
+    rerun = jax.jit(jax.vmap(model.run, in_axes=(_DATA_AXES, 0)))(
+        data, init
+    )
+    return jax.tree_util.tree_map(
+        lambda full, new: jnp.asarray(full).at[ids].set(new),
+        results,
+        rerun,
+    )
