@@ -1,22 +1,39 @@
-"""Benchmark: BASELINE.json ladder config 2 on real hardware.
+"""Benchmark: the BASELINE.json ladder, measured (not extrapolated).
 
-Runs the full meta-kriging pipeline (partition -> warm start -> K
-vmapped subset MCMCs -> combine -> resample -> predict) on a synthetic
-binary spatial field with n=10k, K=10, exponential covariance, and the
-reference's full MCMC budget (5000 iterations, 75% burn-in —
-MetaKriging_BinaryResponse.R:57-59,85).
+Rungs (BASELINE.md ladder; each is a real timed run on this chip):
+
+  config2        n=10k,  K=10, exponential   — the round-1 anchor
+  config3        n=100k, K=32, matern32      — vmap-batched Cholesky rung
+  config5_slice  n=125k, K=32 (m=3906), exponential
+                 — exactly ONE v5e-8 chip's share of the n=1M, K=256
+                 north-star job: subsets are embarrassingly parallel
+                 (zero communication during the fit, SURVEY.md §2.2),
+                 so 8 chips each fitting 32 subsets of m=3906 IS the
+                 full job up to the final (tiny, ICI all-reduce)
+                 quantile combine. Its measured wall-clock is the
+                 per-chip number the 600 s target is judged on — no
+                 cubic extrapolation model anywhere.
+
+Timing is pure execution: the vmapped sampler program is AOT-compiled
+(jit(...).lower(...).compile()) before the clock starts, mirroring the
+reference's own instrumented quantity — the parallel-fit wall-clock
+(MetaKriging_BinaryResponse.R:106-111) — with the reference's full
+MCMC budget (5000 iterations, 75% burn-in, R:57-59,85).
 
 Prints ONE JSON line:
-  metric      — what was measured
-  value       — subset-fit wall-clock seconds (the reference's own
-                instrumented quantity, R:106-111)
+  metric      — the north-star quantity (config5_slice per-chip share)
+  value       — its measured wall-clock seconds
   unit        — "s"
-  vs_baseline — north-star headroom: 600 s (the BASELINE.json n=1M,
-                K=256, v5e-8 10-minute target) divided by this chip's
-                extrapolated share of that job. Extrapolation: per-chip
-                work scales by (subsets per chip) x (m'/m)^3 for the
-                per-iteration m x m Cholesky (SURVEY.md §2.3);
-                values > 1 mean the target is beaten.
+  vs_baseline — 600 s (BASELINE.json 10-minute target) / value;
+                > 1 means the target is beaten
+plus the full ladder (per-rung seconds, latent ESS/sec, effective
+TFLOP/s and HBM GB/s from an analytic op count) as extra keys.
+
+Environment knobs: BENCH_LADDER=full|config2 (default full on TPU,
+config2 elsewhere), BENCH_BUDGET_S soft budget for optional rungs,
+BENCH_SAMPLES / BENCH_CG_ITERS / BENCH_CG_DTYPE / BENCH_PHI_EVERY /
+BENCH_USOLVER override the solver settings (defaults below are the
+validated scaling-regime configuration).
 
 Synthetic latent surfaces use random Fourier features (an O(n)
 stationary GP approximation) so data generation never needs an n x n
@@ -58,74 +75,174 @@ def make_binary_field(key, n, q=1, p=2, phi=6.0, n_features=256):
     return y, x, coords
 
 
-def main():
-    from smk_tpu import SMKConfig, fit_meta_kriging
+def op_model(cfg, m, k, q, n_iters, n_kept, t):
+    """Analytic FLOP / HBM-byte counts for the sampler's hot ops.
+
+    Covers the ops that dominate at scale (SURVEY.md §2.3): the CG
+    solve + Matheron matvecs (bandwidth-bound) and the phi-MH batched
+    Cholesky (the one remaining O(m^3) factorization). Elementwise and
+    O(m) work is ignored — this under-counts slightly, making the
+    derived utilizations conservative.
+    """
+    mv_bytes = 2 if cfg.cg_matvec_dtype == "bfloat16" else 4
+    n_phi = sum(
+        1 for i in range(n_iters) if i % cfg.phi_update_every == 0
+    )
+    per_comp = k * q
+    # CG: one m x m matvec per step; + final apply_r; + u_star L matvec
+    cg_flops = per_comp * n_iters * (cfg.cg_iters + 1) * 2 * m * m
+    ustar_flops = per_comp * n_iters * 2 * m * m
+    # phi MH: proposal Cholesky m^3/3 + rebuild + two triangular solves
+    chol_flops = per_comp * n_phi * (m**3 / 3 + 4 * m * m)
+    # kriging (collect iters): v = trisolve(L, rc) m^2 t; cond_cov t^2 m
+    krige_flops = per_comp * n_kept * (m * m * t + 2 * t * t * m)
+    flops = cg_flops + ustar_flops + chol_flops + krige_flops
+    # HBM traffic: matrix streams per CG step + rebuild + carried reads
+    bytes_ = per_comp * n_iters * (
+        (cfg.cg_iters + 1) * mv_bytes * m * m  # CG + final matvec
+        + 4 * m * m  # dist read for the rebuild
+        + mv_bytes * m * m  # r_mv write
+        + 4 * m * m  # u_star: chol_r read
+    ) + per_comp * n_phi * (4 * 4 * m * m) + per_comp * n_kept * (4 * m * m)
+    return flops, bytes_, {
+        "cg": cg_flops, "chol": chol_flops, "krige": krige_flops,
+    }
+
+
+def run_rung(name, *, n, k, cov_model, n_samples, q=1, p=2, n_test=64,
+             seed=0, solver_env=None):
+    """Measure one ladder rung: AOT-compile the K-vmapped sampler,
+    then time pure execution of the full MCMC fan-out."""
+    from smk_tpu.api import stacked_design
+    from smk_tpu.config import SMKConfig
+    from smk_tpu.models.probit_gp import SpatialGPSampler
+    from smk_tpu.ops.glm import glm_warm_start
+    from smk_tpu.parallel.executor import DATA_AXES, stacked_subset_data
+    from smk_tpu.parallel.partition import random_partition
     from smk_tpu.utils.diagnostics import effective_sample_size
 
-    n = int(os.environ.get("BENCH_N", 10_000))
-    k = int(os.environ.get("BENCH_K", 10))
-    n_samples = int(os.environ.get("BENCH_SAMPLES", 5000))
-    n_test = 64
-
-    key = jax.random.key(0)
-    y, x, coords = make_binary_field(key, n + n_test)
+    env = solver_env or {}
+    key = jax.random.key(seed)
+    y, x, coords = make_binary_field(key, n + n_test, q=q, p=p)
     y, x, coords, coords_test, x_test = (
         y[:n], x[:n], coords[:n], coords[n:], x[n:],
     )
-
-    # Scaling-regime solver settings — this exact combination
-    # (u_solver="cg", cg_iters=48, phi_update_every=2) is validated to
-    # target the same posterior as the exact defaults by
-    # tests/test_sampler.py::TestSolverEquivalence (shared-seed chains,
-    # distribution-level comparison): the u-update solved by 48-step
-    # preconditioned CG through the carried Cholesky factor, and the
-    # phi MH (the one remaining O(m^3) factorization) every 2nd sweep.
     cfg = SMKConfig(
         n_subsets=k,
         n_samples=n_samples,
-        u_solver=os.environ.get("BENCH_USOLVER", "cg"),
-        cg_iters=int(os.environ.get("BENCH_CG_ITERS", 48)),
-        phi_update_every=int(os.environ.get("BENCH_PHI_EVERY", 2)),
+        cov_model=cov_model,
+        u_solver=env.get("BENCH_USOLVER", "cg"),
+        cg_iters=int(env.get("BENCH_CG_ITERS", 32)),
+        cg_matvec_dtype=env.get("BENCH_CG_DTYPE", "bfloat16"),
+        phi_update_every=int(env.get("BENCH_PHI_EVERY", 2)),
     )
-    # Warm-up run with identical shapes populates the XLA compile
-    # cache so the reported wall-clock is pure execution (the scan
-    # program depends only on shapes/config, not data).
-    if os.environ.get("BENCH_WARMUP", "1") == "1":
-        fit_meta_kriging(
-            jax.random.key(1), y, x, coords, coords_test, x_test, config=cfg
+    model = SpatialGPSampler(cfg, weight=1)
+    part = random_partition(jax.random.key(1), y, x, coords, k)
+    data = stacked_subset_data(part, coords_test, x_test)
+    y_long, x_long = stacked_design(y, x)
+    fit = glm_warm_start(y_long, x_long, weight=1, link=cfg.link)
+    beta0 = fit.coef.reshape(q, p)
+    keys = jax.random.split(jax.random.key(2), k)
+    init = jax.jit(
+        jax.vmap(
+            lambda kk, d: model.init_state(kk, d, beta0),
+            in_axes=(0, DATA_AXES),
         )
+    )(keys, data)
+    jax.block_until_ready(init)
+
+    runner = jax.jit(jax.vmap(model.run, in_axes=(DATA_AXES, 0)))
     t0 = time.time()
-    res = fit_meta_kriging(
-        jax.random.key(1), y, x, coords, coords_test, x_test, config=cfg
-    )
-    total = time.time() - t0
-    fit_s = res.phase_seconds["subset_fits"]
+    compiled = runner.lower(data, init).compile()
+    compile_s = time.time() - t0
 
-    # latent-GP ESS/sec (the BASELINE.json companion metric): ESS of
-    # the kept predictive-latent draws, summed over subsets & columns.
-    ess = jax.vmap(effective_sample_size)(res.subset_results.w_samples)
+    t0 = time.time()
+    res = jax.block_until_ready(compiled(data, init))
+    fit_s = time.time() - t0
+
+    ess = jax.vmap(effective_sample_size)(res.w_samples)
     ess_total = float(jnp.sum(ess))
-    ess_per_sec = ess_total / fit_s
+    m = part.x.shape[1]
+    flops, bytes_, parts = op_model(
+        cfg, m, k, q, n_samples, cfg.n_kept, n_test
+    )
+    return {
+        "rung": name,
+        "n": n, "K": k, "m": m, "cov_model": cov_model,
+        "iters": n_samples,
+        "fit_s": round(fit_s, 2),
+        "compile_s": round(compile_s, 1),
+        "latent_ess_per_sec": round(ess_total / fit_s, 1),
+        "phi_accept": round(float(jnp.mean(res.phi_accept_rate)), 3),
+        "eff_tflops": round(flops / fit_s / 1e12, 2),
+        "eff_hbm_gbps": round(bytes_ / fit_s / 1e9, 1),
+    }
 
-    # Extrapolate this chip's share of the n=1M, K=256, v5e-8 job:
-    # 32 subsets/chip at m*=3906 vs k subsets at m=n/k here; per-iter
-    # cost ~ subsets x m^3.
-    m = -(-n // k)
-    m_star, subsets_per_chip = 1_000_000 // 256, 256 // 8
-    scale = (subsets_per_chip / k) * (m_star / m) ** 3
-    extrapolated = fit_s * scale
-    vs_baseline = 600.0 / extrapolated
+
+def main():
+    on_tpu = jax.devices()[0].platform != "cpu"
+    ladder_mode = os.environ.get(
+        "BENCH_LADDER", "full" if on_tpu else "config2"
+    )
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", 2400))
+    n_samples = int(os.environ.get("BENCH_SAMPLES", 5000))
+    env = {
+        k: v for k, v in os.environ.items() if k.startswith("BENCH_")
+    }
+
+    # BENCH_N / BENCH_K resize the first rung (round-1 automation
+    # contract); defaults are BASELINE config 2. BENCH_WARMUP is
+    # obsolete — AOT compilation makes every timing pure execution.
+    t_start = time.time()
+    ladder = [run_rung(
+        "config2",
+        n=int(os.environ.get("BENCH_N", 10_000)),
+        k=int(os.environ.get("BENCH_K", 10)),
+        cov_model="exponential",
+        n_samples=n_samples, solver_env=env,
+    )]
+    if ladder_mode == "full":
+        # most-important-first: the north-star slice, then config 3,
+        # each gated on the remaining soft budget
+        est_slice = 15 * ladder[0]["fit_s"] + 120  # rough upper bound
+        if time.time() - t_start + est_slice < budget_s:
+            ladder.append(run_rung(
+                "config5_slice", n=32 * 3906, k=32,
+                cov_model="exponential", n_samples=n_samples,
+                solver_env=env,
+            ))
+        if time.time() - t_start + 0.6 * est_slice < budget_s:
+            ladder.append(run_rung(
+                "config3", n=100_000, k=32, cov_model="matern32",
+                n_samples=n_samples, solver_env=env,
+            ))
+
+    by_name = {r["rung"]: r for r in ladder}
+    if "config5_slice" in by_name:
+        head = by_name["config5_slice"]
+        value = head["fit_s"]
+        metric = (
+            f"n=1M K=256 per-chip share, MEASURED (32 subsets x "
+            f"m=3906, {head['iters']} MCMC iters, exponential cov)"
+        )
+        vs_baseline = 600.0 / value
+    else:
+        head = by_name["config2"]
+        value = head["fit_s"]
+        metric = (
+            f"SMK subset-fit wall-clock (n={head['n']}, K={head['K']}, "
+            f"{head['iters']} MCMC iters, exponential cov)"
+        )
+        # round-1 comparable: headroom vs the same cubic model r01 used
+        m, m_star, spc = head["m"], 1_000_000 // 256, 256 // 8
+        vs_baseline = 600.0 / (value * (spc / head["K"]) * (m_star / m) ** 3)
 
     print(json.dumps({
-        "metric": f"SMK subset-fit wall-clock (n={n}, K={k}, "
-                  f"{n_samples} MCMC iters, exponential cov)",
-        "value": round(fit_s, 2),
+        "metric": metric,
+        "value": value,
         "unit": "s",
         "vs_baseline": round(vs_baseline, 3),
-        "total_pipeline_s": round(total, 2),
-        "latent_ess_per_sec": round(ess_per_sec, 1),
-        "extrapolated_1M_K256_v5e8_s": round(extrapolated, 1),
-        "phases": {kk: round(v, 2) for kk, v in res.phase_seconds.items()},
+        "ladder": ladder,
     }))
 
 

@@ -177,6 +177,12 @@ def fit_meta_kriging(
                 raise ValueError(
                     "checkpoint_path and sharded are mutually exclusive"
                 )
+            if chunk_size is not None:
+                raise ValueError(
+                    "checkpoint_path does not support chunk_size yet — "
+                    "the checkpointed executor vmaps all K subsets at "
+                    "once; drop one of the two arguments"
+                )
             from smk_tpu.parallel.recovery import fit_subsets_checkpointed
 
             results = fit_subsets_checkpointed(

@@ -95,11 +95,19 @@ class SMKConfig:
     phi_update_every: int = 1
 
     # Solver for the u-update's (R + D) system: "chol" = exact dense
-    # Cholesky; "cg" = fixed-iteration conjugate gradient with the
-    # matvec through the carried chol(R) factor — O(cg_iters * m^2)
-    # batched matmuls instead of O(m^3), the scaling-regime choice.
+    # Cholesky; "cg" = fixed-iteration conjugate gradient with R
+    # applied directly (rebuilt elementwise from the distance matrix
+    # once per sweep) — O(cg_iters * m^2) of single-matvec work
+    # instead of O(m^3), the scaling-regime choice. The solve is HBM-
+    # bandwidth-bound (each CG step streams the m x m matrix), so
+    # cg_matvec_dtype="bfloat16" stores the matrix half-width and
+    # halves the traffic; CG vectors and accumulation stay float32.
+    # The bfloat16 matrix perturbs correlations at ~2^-8 relative —
+    # validated posterior-equivalent to the exact path in
+    # tests/test_sampler.py::TestSolverEquivalence.
     u_solver: str = "chol"
     cg_iters: int = 64
+    cg_matvec_dtype: str = "float32"
 
     # Pólya-Gamma series truncation for the logit link: omega is drawn
     # from the defining infinite series cut at this many terms with
@@ -138,6 +146,10 @@ class SMKConfig:
             raise ValueError("burn_in_frac must be in (0, 1)")
         if self.u_solver not in ("chol", "cg"):
             raise ValueError("u_solver must be 'chol' or 'cg'")
+        if self.cg_matvec_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                "cg_matvec_dtype must be 'float32' or 'bfloat16'"
+            )
         if self.phi_update_every < 1:
             raise ValueError("phi_update_every must be >= 1")
         if not 0.0 < self.phi_target_accept < 1.0:

@@ -343,17 +343,40 @@ class SpatialGPSampler:
             )
             rhs_vec = ytilde - u_star - eta_star
             if cfg.u_solver == "cg":
-                # (R + D) x = rhs with R applied as L (L^T x): two
-                # batched matmuls per CG step — O(cg_iters * m^2) of
-                # MXU work replaces the O(m^3) factorization; Jacobi
-                # preconditioning absorbs the huge padded-row d's.
+                # (R + D) x = rhs with R applied *directly* — rebuilt
+                # elementwise from the distance matrix once per sweep
+                # (one m^2 read of dist), so each CG step is ONE m x m
+                # matvec instead of the two through the carried factor.
+                # The solve is HBM-bandwidth-bound (the matrix streams
+                # from HBM every step); cg_matvec_dtype="bfloat16"
+                # stores R half-width, halving that traffic, while the
+                # CG vectors and the accumulation stay in `dtype`.
+                # Jacobi preconditioning absorbs the huge padded-row
+                # d's; the jitter rides the diagonal term so the
+                # operator matches what chol_r factors.
+                mv_dtype = (
+                    jnp.bfloat16
+                    if cfg.cg_matvec_dtype == "bfloat16"
+                    else dtype
+                )
+                r_mv = masked_correlation(
+                    dist, phi[j], mask, cfg.cov_model
+                ).astype(mv_dtype)
+
+                def apply_r(x, r_mv=r_mv):
+                    return jnp.matmul(
+                        r_mv,
+                        x.astype(mv_dtype),
+                        preferred_element_type=dtype,
+                    ).astype(dtype)
+
                 def mv(x):
-                    return l_j @ (l_j.T @ x) + d_vec * x
+                    return apply_r(x) + (cfg.jitter + d_vec) * x
 
                 s = cg_solve(
                     mv, rhs_vec, cfg.cg_iters, diag=1.0 + cfg.jitter + d_vec
                 )
-                u = u.at[:, j].set(u_star + l_j @ (l_j.T @ s))
+                u = u.at[:, j].set(u_star + apply_r(s) + cfg.jitter * s)
             else:
                 # exact dense path: R rebuilt elementwise from the
                 # distance matrix — O(m^2), not the O(m^3) L @ L^T.

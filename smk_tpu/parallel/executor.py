@@ -36,10 +36,10 @@ from smk_tpu.parallel.partition import Partition
 # vmap axes for SubsetData: subset-local fields batch on axis 0, test
 # locations are shared across subsets (broadcast), matching the
 # reference where every worker predicts at the same coords.test (R:87).
-_DATA_AXES = SubsetData(coords=0, x=0, y=0, mask=0, coords_test=None, x_test=None)
+DATA_AXES = SubsetData(coords=0, x=0, y=0, mask=0, coords_test=None, x_test=None)
 
 
-def _stacked_data(
+def stacked_subset_data(
     part: Partition, coords_test: jnp.ndarray, x_test: jnp.ndarray
 ) -> SubsetData:
     return SubsetData(
@@ -50,6 +50,11 @@ def _stacked_data(
         coords_test=coords_test,
         x_test=x_test,
     )
+
+
+# backwards-compatible private aliases
+_DATA_AXES = DATA_AXES
+_stacked_data = stacked_subset_data
 
 
 def fit_subsets_vmap(

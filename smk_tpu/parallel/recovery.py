@@ -22,6 +22,7 @@ Here both durability subsystems are real:
 from __future__ import annotations
 
 import os
+import zlib
 from typing import Optional, Sequence
 
 import jax
@@ -34,15 +35,33 @@ from smk_tpu.models.probit_gp import (
     SubsetResult,
     n_params,
 )
-from smk_tpu.parallel.executor import _DATA_AXES, _stacked_data
+from smk_tpu.parallel.executor import DATA_AXES, stacked_subset_data
 from smk_tpu.parallel.partition import Partition
 from smk_tpu.utils.checkpoint import load_pytree, save_pytree
+
+
+def _run_identity(cfg, key, data, beta_init) -> np.ndarray:
+    """Fingerprint of everything that determines the chain: the full
+    config (its repr covers every field incl. priors), the fan-out
+    PRNG key, and the raw bytes of the data slices + warm start. A
+    checkpoint written under a different identity is rejected instead
+    of being silently resumed/returned (two runs differing only in
+    cov_model, key, or data have identical array shapes)."""
+    crcs = [zlib.crc32(repr(cfg).encode())]
+    crcs.append(zlib.crc32(np.asarray(jax.random.key_data(key)).tobytes()))
+    for leaf in jax.tree_util.tree_leaves(data):
+        crcs.append(zlib.crc32(np.ascontiguousarray(leaf).tobytes()))
+    if beta_init is not None:
+        crcs.append(
+            zlib.crc32(np.ascontiguousarray(beta_init).tobytes())
+        )
+    return np.asarray(crcs, np.uint32)
 
 
 def _init_states(model, keys, data, beta_init):
     return jax.vmap(
         lambda kk, d: model.init_state(kk, d, beta_init),
-        in_axes=(0, _DATA_AXES),
+        in_axes=(0, DATA_AXES),
     )(keys, data)
 
 
@@ -67,10 +86,17 @@ def fit_subsets_checkpointed(
     checkpoint on disk) — the hook the kill-and-resume test uses.
     """
     cfg = model.config
+    if chunk_iters < 1:
+        raise ValueError(f"chunk_iters must be >= 1, got {chunk_iters}")
     k = part.n_subsets
-    data = _stacked_data(part, coords_test, x_test)
+    data = stacked_subset_data(part, coords_test, x_test)
     keys = jax.random.split(key, k)
-    init = _init_states(model, keys, data, beta_init)
+    # Shape-only template: the resume branch never needs the real init
+    # states (they'd cost K masked-correlation builds + K O(m^3)
+    # Choleskys just to be discarded for ckpt["state"]).
+    init_like = jax.eval_shape(
+        lambda kk, d: _init_states(model, kk, d, beta_init), keys, data
+    )
 
     m, q, p = part.x.shape[1:]
     d_par = n_params(q, p)
@@ -86,11 +112,13 @@ def fit_subsets_checkpointed(
     meta = np.asarray(
         [cfg.n_samples, cfg.n_burn_in, k, d_par, d_w], np.int64
     )
+    ident = _run_identity(cfg, key, data, beta_init)
     like = {
-        "state": init,
+        "state": init_like,
         "param_draws": empty_draws()[0],
         "w_draws": empty_draws()[1],
         "meta": meta,
+        "ident": ident,
     }
 
     if os.path.exists(checkpoint_path):
@@ -101,13 +129,21 @@ def fit_subsets_checkpointed(
                 f"different run: meta {np.asarray(ckpt['meta'])} vs "
                 f"expected {meta}"
             )
+        if not np.array_equal(np.asarray(ckpt["ident"]), ident):
+            raise ValueError(
+                f"checkpoint {checkpoint_path} was written for a "
+                "different run: config/key/data fingerprint mismatch "
+                "(same shapes, different chain) — delete the file or "
+                "pass a different checkpoint_path"
+            )
         # leaves arrive as numpy (PRNG keys re-wrapped by load_pytree);
         # jax consumes them directly
         state = ckpt["state"]
         param_draws = jnp.asarray(ckpt["param_draws"], dtype)
         w_draws = jnp.asarray(ckpt["w_draws"], dtype)
     else:
-        burn = jax.jit(jax.vmap(model.burn_in, in_axes=(_DATA_AXES, 0)))
+        init = _init_states(model, keys, data, beta_init)
+        burn = jax.jit(jax.vmap(model.burn_in, in_axes=(DATA_AXES, 0)))
         state = burn(data, init)
         param_draws, w_draws = empty_draws()
         save_pytree(
@@ -117,6 +153,7 @@ def fit_subsets_checkpointed(
                 "param_draws": param_draws,
                 "w_draws": w_draws,
                 "meta": meta,
+                "ident": ident,
             },
         )
 
@@ -127,7 +164,7 @@ def fit_subsets_checkpointed(
             chunk_fns[n] = jax.jit(
                 jax.vmap(
                     lambda d_, s_, t_: model.sample_chunk(d_, s_, t_, n),
-                    in_axes=(_DATA_AXES, 0, None),
+                    in_axes=(DATA_AXES, 0, None),
                 )
             )
         return chunk_fns[n]
@@ -147,6 +184,7 @@ def fit_subsets_checkpointed(
                 "param_draws": param_draws,
                 "w_draws": w_draws,
                 "meta": meta,
+                "ident": ident,
             },
         )
         chunks_done += 1
@@ -199,7 +237,7 @@ def rerun_subsets(
         x_test=x_test,
     )
     init = _init_states(model, keys, data, beta_init)
-    rerun = jax.jit(jax.vmap(model.run, in_axes=(_DATA_AXES, 0)))(
+    rerun = jax.jit(jax.vmap(model.run, in_axes=(DATA_AXES, 0)))(
         data, init
     )
     return jax.tree_util.tree_map(

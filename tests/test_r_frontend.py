@@ -1,0 +1,137 @@
+"""The R front-end's exact call sequence, executed from Python.
+
+No R runtime ships in this image, so ``r/meta_kriging_tpu.R`` (the
+north-star ``backend=`` switch) is exercised by replicating, step for
+step, every conversion and attribute access the R code performs via
+reticulate — the things that only break when actually run:
+
+- the array-layout conversions: R's ``sapply(y, as.numeric)`` (column
+  stack -> n x q), ``aperm(simplify2array(x), c(1, 3, 2))`` (list of q
+  n x p matrices -> n x q x p) and the same for x.test
+  (r/meta_kriging_tpu.R:68-70),
+- the attribute path reticulate resolves: ``smk$SMKConfig``,
+  ``smk$fit_meta_kriging``, ``smk$api$param_names``
+  (r/meta_kriging_tpu.R:76-109),
+- every result field the R list constructor reads
+  (r/meta_kriging_tpu.R:98-110), with the shapes the reference
+  script's outputs have (MetaKriging_BinaryResponse.R:123-165).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+
+def _r_simplify2array_aperm(mats):
+    """R: aperm(simplify2array(list of n x p), c(1, 3, 2)) -> n x q x p.
+
+    ``simplify2array`` stacks the list along a NEW LAST axis (n, p, q);
+    ``aperm(c(1, 3, 2))`` permutes to (n, q, p)."""
+    stacked = np.stack(mats, axis=-1)  # (n, p, q)
+    return np.transpose(stacked, (0, 2, 1))  # (n, q, p)
+
+
+@pytest.fixture(scope="module")
+def r_style_inputs():
+    """Inputs exactly as an R user of the reference holds them:
+    q separate response vectors and design matrices (the reference's
+    free globals y.1, y.2, x.1, x.2 — SURVEY.md §1.1)."""
+    rng = np.random.default_rng(7)
+    n, t, q, p = 96, 5, 2, 2
+    y_list = [rng.integers(0, 2, n).astype(np.float64) for _ in range(q)]
+    x_list = [rng.normal(size=(n, p)) for _ in range(q)]
+    xt_list = [rng.normal(size=(t, p)) for _ in range(q)]
+    coords = rng.uniform(size=(n, 2))
+    coords_test = rng.uniform(size=(t, 2))
+    return y_list, x_list, xt_list, coords, coords_test
+
+
+class TestRFrontendCallSequence:
+    def test_full_call_sequence(self, r_style_inputs):
+        y_list, x_list, xt_list, coords, coords_test = r_style_inputs
+        q, p = len(y_list), x_list[0].shape[1]
+        n, t = len(y_list[0]), coords_test.shape[0]
+
+        # --- r/meta_kriging_tpu.R:68-70: the layout conversions ------
+        y_arr = np.column_stack(y_list)  # sapply -> n x q
+        x_arr = _r_simplify2array_aperm(x_list)
+        xt_arr = _r_simplify2array_aperm(xt_list)
+        assert y_arr.shape == (n, q)
+        assert x_arr.shape == (n, q, p)
+        assert xt_arr.shape == (t, q, p)
+        # aperm correctness: response j's design must round-trip
+        for j in range(q):
+            np.testing.assert_array_equal(x_arr[:, j, :], x_list[j])
+
+        # --- r/meta_kriging_tpu.R:72-76: module imports (reticulate
+        # resolves `smk$api$...` as attribute access on the package) --
+        import smk_tpu as smk
+
+        assert hasattr(smk, "SMKConfig")
+        assert hasattr(smk, "fit_meta_kriging")
+        assert hasattr(smk.api, "param_names"), (
+            "r front-end reads smk$api$param_names (meta_kriging_tpu."
+            "R:109); smk_tpu.api must be reachable as an attribute"
+        )
+
+        # --- r/meta_kriging_tpu.R:78-95: config + fit, exactly the
+        # keyword set the R code passes --------------------------------
+        cfg = smk.SMKConfig(
+            n_subsets=4,
+            n_samples=60,
+            burn_in_frac=0.5,
+            cov_model="exponential",
+            combiner="wasserstein_mean",
+            link="logit",  # the reference workflow's link (R:160)
+            n_quantiles=20,
+            resample_size=50,
+        )
+        res = smk.fit_meta_kriging(
+            jax.random.key(0),
+            np.float32(1) * y_arr.astype(np.float32),
+            x_arr.astype(np.float32),
+            coords.astype(np.float32),
+            coords_test.astype(np.float32),
+            xt_arr.astype(np.float32),
+            config=cfg,
+            weight=1,
+        )
+
+        # --- r/meta_kriging_tpu.R:98-110: every field the R list
+        # constructor touches, with the reference output shapes -------
+        d_par = smk.models.probit_gp.n_params(q, p)
+        out = {
+            "result": np.asarray(res.param_grid),
+            "result2": np.asarray(res.w_grid),
+            "SamplePar": np.asarray(res.sample_par),
+            "Samplew": np.asarray(res.sample_w),
+            "p.sample": np.asarray(res.p_samples),
+            "param.quant": np.asarray(res.param_quant),
+            "w.quant": np.asarray(res.w_quant),
+            "p.quant": np.asarray(res.p_quant),
+            "phi.accept": np.asarray(res.phi_accept_rate),
+        }
+        assert out["result"].shape == (cfg.n_quantiles, d_par)
+        assert out["result2"].shape == (cfg.n_quantiles, t * q)
+        assert out["SamplePar"].shape == (cfg.resample_size, d_par)
+        assert out["Samplew"].shape == (cfg.resample_size, t * q)
+        assert out["p.sample"].shape == (cfg.resample_size, t * q)
+        assert out["param.quant"].shape == (3, d_par)
+        assert out["w.quant"].shape == (3, t * q)
+        assert out["p.quant"].shape == (3, t * q)
+        assert out["phi.accept"].shape == (cfg.n_subsets, q)
+        for name, arr in out.items():
+            assert np.isfinite(arr).all(), f"{name} has non-finite values"
+        assert ((out["p.sample"] >= 0) & (out["p.sample"] <= 1)).all()
+
+        # phases dict is consumed as a plain R list (R:108)
+        assert set(res.phase_seconds) == {
+            "partition", "warm_start", "subset_fits", "combine",
+            "resample_predict",
+        }
+
+        # param.names (R:109): one name per parameter column
+        names = smk.api.param_names(q, p)
+        assert len(names) == d_par
+        assert names[0] == "beta[0,0]" and names[-1] == f"phi[{q - 1}]"

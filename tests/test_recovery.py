@@ -93,6 +93,43 @@ class TestCheckpointedFit:
                 checkpoint_path=path, chunk_iters=10,
             )
 
+    def test_same_shapes_different_chain_rejected(self, problem, tmp_path):
+        """A checkpoint from a run with identical array shapes but a
+        different PRNG key (or config that doesn't change shapes, e.g.
+        cov_model) must be rejected, not silently resumed/returned."""
+        model, part, ct, xt, key = problem
+        path = os.path.join(tmp_path, "ident.npz")
+        fit_subsets_checkpointed(
+            model, part, ct, xt, key,
+            checkpoint_path=path, chunk_iters=10, stop_after_chunks=1,
+        )
+        with pytest.raises(ValueError, match="fingerprint mismatch"):
+            fit_subsets_checkpointed(
+                model, part, ct, xt, jax.random.key(99),
+                checkpoint_path=path, chunk_iters=10,
+            )
+        other_cov = SpatialProbitGP(
+            SMKConfig(
+                n_subsets=4, n_samples=80, burn_in_frac=0.5,
+                cov_model="matern32",
+            ),
+            weight=1,
+        )
+        with pytest.raises(ValueError, match="fingerprint mismatch"):
+            fit_subsets_checkpointed(
+                other_cov, part, ct, xt, key,
+                checkpoint_path=path, chunk_iters=10,
+            )
+
+    def test_bad_chunk_iters_rejected(self, problem, tmp_path):
+        model, part, ct, xt, key = problem
+        with pytest.raises(ValueError, match="chunk_iters"):
+            fit_subsets_checkpointed(
+                model, part, ct, xt, key,
+                checkpoint_path=os.path.join(tmp_path, "z.npz"),
+                chunk_iters=0,
+            )
+
 
 class TestApiCheckpointPath:
     def test_pipeline_with_checkpointing(self, problem, tmp_path):

@@ -270,11 +270,24 @@ class TestSolverEquivalence:
         res = self._fit(data, phi_update_every=2)
         _posteriors_agree(ps_exact, np.asarray(res.param_samples))
 
+    def test_cg_bf16_matvec_matches(self, shared):
+        """bfloat16-stored CG matrix (the bandwidth optimization)
+        targets the same posterior as the exact solver."""
+        data, ps_exact = shared
+        res = self._fit(
+            data, u_solver="cg", cg_iters=32, cg_matvec_dtype="bfloat16"
+        )
+        _posteriors_agree(ps_exact, np.asarray(res.param_samples))
+
     def test_bench_config_matches(self, shared):
         """The full benchmark combination, exactly as bench.py sets it."""
         data, ps_exact = shared
         res = self._fit(
-            data, u_solver="cg", cg_iters=48, phi_update_every=2
+            data,
+            u_solver="cg",
+            cg_iters=32,
+            cg_matvec_dtype="bfloat16",
+            phi_update_every=2,
         )
         _posteriors_agree(ps_exact, np.asarray(res.param_samples))
         assert 0.2 < float(res.phi_accept_rate[0]) < 0.7
