@@ -1,0 +1,17 @@
+"""Data loaders — the presence/absence (eBird) path of BASELINE
+config 4. The reference has no loaders; inputs are free R globals
+(SURVEY.md §1.1)."""
+
+from smk_tpu.data.ebird import (
+    PresenceAbsenceData,
+    load_presence_absence_csv,
+    make_ebird_proxy,
+    write_presence_absence_csv,
+)
+
+__all__ = [
+    "PresenceAbsenceData",
+    "load_presence_absence_csv",
+    "make_ebird_proxy",
+    "write_presence_absence_csv",
+]

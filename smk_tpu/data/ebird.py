@@ -1,0 +1,203 @@
+"""Presence/absence data path — BASELINE config 4 (eBird, K=64).
+
+Two entry points:
+
+- ``load_presence_absence_csv``: loader for real eBird-style
+  checklist exports — rows are checklists with coordinates, effort
+  covariates and per-species presence/absence columns. Returns the
+  framework's array layouts, ready for ``fit_meta_kriging``.
+- ``make_ebird_proxy``: a deterministic offline proxy with the
+  statistical signatures of citizen-science occurrence data (this
+  image has no network egress, so benchmarks use the proxy): checklist
+  locations follow a Thomas cluster process around birding "hotspots"
+  overlaid on an accessibility gradient (observations cluster hard —
+  nothing like uniform), covariates are a smooth elevation field and a
+  per-checklist effort level, and q=2 species' presences come from a
+  logit model with cross-correlated latent GP fields (LMC, as the
+  reference models multivariate dependence,
+  MetaKriging_BinaryResponse.R:56,64) at realistic prevalences
+  (common ~25%, scarce ~10%).
+
+The reference has no data loader of any kind — its inputs are free R
+globals the user must assemble by hand (SURVEY.md §1.1).
+"""
+
+from __future__ import annotations
+
+import csv
+from typing import NamedTuple, Optional, Sequence
+
+import numpy as np
+
+
+class PresenceAbsenceData(NamedTuple):
+    """Array layouts for fit_meta_kriging.
+
+    y:      (n, q) 0/1 presence per checklist x species
+    x:      (n, q, p) per-species design rows (shared checklist
+            covariates replicated across the species axis)
+    coords: (n, 2) locations, rescaled to the unit square
+    covariate_names: p column names
+    species_names: q column names
+    """
+
+    y: np.ndarray
+    x: np.ndarray
+    coords: np.ndarray
+    covariate_names: tuple
+    species_names: tuple
+
+
+def _standardize(v: np.ndarray) -> np.ndarray:
+    sd = v.std()
+    return (v - v.mean()) / (sd if sd > 0 else 1.0)
+
+
+def load_presence_absence_csv(
+    path: str,
+    species_cols: Sequence[str],
+    *,
+    lat_col: str = "latitude",
+    lon_col: str = "longitude",
+    covariate_cols: Sequence[str] = ("effort_hrs",),
+    max_rows: Optional[int] = None,
+) -> PresenceAbsenceData:
+    """Load an eBird-style checklist CSV into framework layouts.
+
+    Each row is one checklist; ``species_cols`` hold 0/1 detections.
+    Coordinates are min-max rescaled to the unit square (the sampler's
+    phi prior, Unif(4, 12) on a unit domain, assumes O(1) distances —
+    reference prior at MetaKriging_BinaryResponse.R:63); covariates
+    are standardized and an intercept column is prepended.
+    """
+    lat, lon, covs, ys = [], [], [], []
+    with open(path, newline="") as f:
+        reader = csv.DictReader(f)
+        for i, row in enumerate(reader):
+            if max_rows is not None and i >= max_rows:
+                break
+            lat.append(float(row[lat_col]))
+            lon.append(float(row[lon_col]))
+            covs.append([float(row[c]) for c in covariate_cols])
+            ys.append([float(row[s]) for s in species_cols])
+    if not lat:
+        raise ValueError(f"no rows read from {path}")
+    coords = np.stack([np.asarray(lon), np.asarray(lat)], axis=1)
+    span = np.maximum(coords.max(0) - coords.min(0), 1e-12)
+    coords = (coords - coords.min(0)) / span.max()  # isotropic rescale
+    covs = np.asarray(covs, np.float64)
+    design = np.concatenate(
+        [np.ones((len(lat), 1)), _standardize(covs)], axis=1
+    )
+    q = len(species_cols)
+    x = np.repeat(design[:, None, :], q, axis=1)
+    return PresenceAbsenceData(
+        y=np.asarray(ys, np.float32),
+        x=x.astype(np.float32),
+        coords=coords.astype(np.float32),
+        covariate_names=("intercept",) + tuple(covariate_cols),
+        species_names=tuple(species_cols),
+    )
+
+
+def make_ebird_proxy(
+    n: int = 65_536,
+    *,
+    seed: int = 0,
+    n_hotspots: int = 96,
+    hotspot_scale: float = 0.006,
+    hotspot_frac: float = 0.85,
+    n_features: int = 384,
+    phi: tuple = (9.0, 5.0),
+) -> PresenceAbsenceData:
+    """Deterministic eBird-like proxy (see module docstring).
+
+    Locations: ``hotspot_frac`` of checklists scatter N(center,
+    hotspot_scale^2) around Thomas-process hotspot centers whose
+    intensity follows an accessibility gradient; the rest are uniform
+    background (roadside incidental lists). Latent fields: q=2
+    unit-variance exponential-covariance GPs via random Fourier
+    features, mixed by a lower-triangular A (LMC) so the two species'
+    surfaces are cross-correlated. Presence: logit(eta) with
+    species-specific effort and elevation effects, intercepts set for
+    ~25% / ~10% prevalence.
+    """
+    rng = np.random.default_rng(seed)
+    q, p = 2, 3
+
+    # --- locations: Thomas cluster process + background ---------------
+    centers = rng.uniform(0.03, 0.97, size=(n_hotspots, 2))
+    # accessibility gradient: hotspots near the (0, 0) "urban" corner
+    # attract more checklists
+    weights = np.exp(-1.8 * centers.sum(axis=1))
+    weights /= weights.sum()
+    n_hot = int(hotspot_frac * n)
+    assign = rng.choice(n_hotspots, size=n_hot, p=weights)
+    pts_hot = centers[assign] + hotspot_scale * rng.normal(size=(n_hot, 2))
+    pts_bg = rng.uniform(size=(n - n_hot, 2))
+    coords = np.clip(np.concatenate([pts_hot, pts_bg]), 0.0, 1.0)
+    order = rng.permutation(n)
+    coords = coords[order]
+
+    # --- covariates: effort + smooth elevation ------------------------
+    effort = _standardize(rng.gamma(2.0, 0.75, size=n))  # list-hours
+    kx = rng.normal(size=(2, 4)) * 2.2
+    elev = np.cos(coords @ kx + rng.uniform(0, 2 * np.pi, 4)).sum(axis=1)
+    elev = _standardize(elev + 0.3 * rng.normal(size=n))
+    design = np.stack([np.ones(n), effort, elev], axis=1)  # (n, p)
+
+    # --- latent LMC fields (RFF exponential GPs) ----------------------
+    u = np.empty((n, q))
+    for j in range(q):
+        freqs = phi[j] * rng.standard_cauchy(size=(n_features, 2))
+        phase = rng.uniform(0, 2 * np.pi, n_features)
+        coef = rng.normal(size=n_features)
+        u[:, j] = np.sqrt(2.0 / n_features) * np.cos(
+            coords @ freqs.T + phase
+        ) @ coef
+    a = np.array([[1.0, 0.0], [0.55, 0.8]])  # cross-covariance K = A A^T
+    w = u @ a.T
+
+    # --- presence: logit link, realistic prevalence -------------------
+    beta = np.array(
+        [[-1.3, 0.55, 0.35],   # common species, mid-elevation
+         [-2.4, 0.75, -0.60]]  # scarce species, low-elevation
+    )
+    eta = design @ beta.T + w  # (n, q)
+    prob = 1.0 / (1.0 + np.exp(-eta))
+    y = (rng.uniform(size=(n, q)) < prob).astype(np.float32)
+
+    x = np.repeat(design[:, None, :], q, axis=1)
+    return PresenceAbsenceData(
+        y=y,
+        x=x.astype(np.float32),
+        coords=coords.astype(np.float32),
+        covariate_names=("intercept", "effort", "elevation"),
+        species_names=("species_common", "species_scarce"),
+    )
+
+
+def write_presence_absence_csv(
+    path: str, data: PresenceAbsenceData
+) -> None:
+    """Write a PresenceAbsenceData back to the CSV schema
+    ``load_presence_absence_csv`` reads (round-trip utility; also how
+    the proxy can be materialized on disk as a committed dataset)."""
+    cov_names = [c for c in data.covariate_names if c != "intercept"]
+    cov_idx = [
+        i for i, c in enumerate(data.covariate_names) if c != "intercept"
+    ]
+    with open(path, "w", newline="") as f:
+        writer = csv.writer(f)
+        writer.writerow(
+            ["latitude", "longitude", *cov_names, *data.species_names]
+        )
+        for i in range(data.y.shape[0]):
+            writer.writerow(
+                [
+                    f"{data.coords[i, 1]:.6f}",
+                    f"{data.coords[i, 0]:.6f}",
+                    *(f"{data.x[i, 0, j]:.6f}" for j in cov_idx),
+                    *(int(v) for v in data.y[i]),
+                ]
+            )

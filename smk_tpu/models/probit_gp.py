@@ -512,6 +512,31 @@ class SpatialGPSampler:
         )
         return state._replace(phi_accept=jnp.zeros_like(state.phi_accept))
 
+    def burn_chunk(
+        self,
+        data: SubsetData,
+        state: SamplerState,
+        start_it,
+        n_iters: int,
+    ) -> SamplerState:
+        """Non-collecting scan over burn-in iterations [start_it,
+        start_it + n_iters) — the chunked form of ``burn_in`` (same
+        adaptation schedule; the Robbins–Monro gain depends on the
+        global iteration index, which ``start_it`` carries). Callers
+        chunking the burn-in must reset ``phi_accept`` to zero after
+        the last chunk, as ``burn_in`` does, so reported acceptance
+        rates are post-burn-in."""
+        with jax.default_matmul_precision(self.config.matmul_precision):
+            consts = self._consts(data)
+            step = lambda st, it: (
+                self._gibbs_step(data, consts, st, it, collect=False)[0],
+                None,
+            )
+            state, _ = lax.scan(
+                step, state, start_it + jnp.arange(n_iters)
+            )
+            return state
+
     def sample_chunk(
         self,
         data: SubsetData,

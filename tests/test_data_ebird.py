@@ -1,0 +1,125 @@
+"""Presence/absence data path (BASELINE config 4): proxy generator
+statistical signatures, CSV loader round-trip, and an end-to-end fit
+through the public API."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from smk_tpu.data import (
+    load_presence_absence_csv,
+    make_ebird_proxy,
+    write_presence_absence_csv,
+)
+
+
+@pytest.fixture(scope="module")
+def proxy():
+    return make_ebird_proxy(n=4096, seed=3)
+
+
+class TestProxySignatures:
+    def test_shapes_and_layouts(self, proxy):
+        n = 4096
+        assert proxy.y.shape == (n, 2)
+        assert proxy.x.shape == (n, 2, 3)
+        assert proxy.coords.shape == (n, 2)
+        assert proxy.coords.min() >= 0 and proxy.coords.max() <= 1
+        assert set(np.unique(proxy.y)) <= {0.0, 1.0}
+        # per-species design rows share checklist covariates
+        np.testing.assert_array_equal(proxy.x[:, 0, :], proxy.x[:, 1, :])
+        assert np.allclose(proxy.x[:, 0, 0], 1.0)  # intercept
+
+    def test_deterministic_by_seed(self):
+        a = make_ebird_proxy(n=512, seed=9)
+        b = make_ebird_proxy(n=512, seed=9)
+        c = make_ebird_proxy(n=512, seed=10)
+        np.testing.assert_array_equal(a.coords, b.coords)
+        np.testing.assert_array_equal(a.y, b.y)
+        assert not np.array_equal(a.coords, c.coords)
+
+    def test_realistic_prevalence(self, proxy):
+        prev = proxy.y.mean(axis=0)
+        assert 0.12 < prev[0] < 0.45, prev  # common species
+        assert 0.03 < prev[1] < 0.22, prev  # scarce species
+        assert prev[0] > prev[1]
+
+    def test_spatial_clustering(self, proxy):
+        """Citizen-science locations cluster around hotspots: the mean
+        nearest-neighbour distance must be far below the uniform-
+        Poisson expectation 0.5/sqrt(n) (Clark–Evans ratio << 1)."""
+        pts = proxy.coords[:1500]
+        d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+        np.fill_diagonal(d, np.inf)
+        nn = d.min(axis=1).mean()
+        uniform_nn = 0.5 / np.sqrt(len(pts))
+        assert nn < 0.6 * uniform_nn, (nn, uniform_nn)
+
+    def test_latent_spatial_signal(self, proxy):
+        """Presence must be spatially autocorrelated beyond what the
+        covariates explain: neighbouring checklists agree more often
+        than distant ones (join-count style check)."""
+        pts, y = proxy.coords[:2000], proxy.y[:2000, 0]
+        d = np.linalg.norm(pts[:, None] - pts[None, :], axis=-1)
+        np.fill_diagonal(d, np.inf)
+        near = d < 0.01
+        far = (d > 0.3) & np.isfinite(d)
+        agree = y[:, None] == y[None, :]
+        assert agree[near].mean() > agree[far].mean() + 0.02
+
+
+class TestCsvLoader:
+    def test_round_trip(self, tmp_path, proxy):
+        path = str(tmp_path / "ebird.csv")
+        small = make_ebird_proxy(n=256, seed=1)
+        write_presence_absence_csv(path, small)
+        back = load_presence_absence_csv(
+            path,
+            species_cols=list(small.species_names),
+            covariate_cols=("effort", "elevation"),
+        )
+        np.testing.assert_array_equal(back.y, small.y)
+        assert back.x.shape == small.x.shape
+        # loader standardizes covariates and isotropically rescales
+        # coordinates — spatial structure is preserved up to a scale
+        d_orig = np.linalg.norm(small.coords[0] - small.coords[1])
+        d_back = np.linalg.norm(back.coords[0] - back.coords[1])
+        if d_orig > 1e-6:
+            ratios = []
+            for i, j in [(0, 1), (2, 3), (10, 20)]:
+                do = np.linalg.norm(small.coords[i] - small.coords[j])
+                db = np.linalg.norm(back.coords[i] - back.coords[j])
+                if do > 1e-6:
+                    ratios.append(db / do)
+            assert np.ptp(ratios) < 1e-3  # one global scale factor
+
+    def test_missing_rows_raise(self, tmp_path):
+        path = str(tmp_path / "empty.csv")
+        with open(path, "w") as f:
+            f.write("latitude,longitude,effort_hrs,sp\n")
+        with pytest.raises(ValueError, match="no rows"):
+            load_presence_absence_csv(path, species_cols=["sp"])
+
+
+class TestEndToEnd:
+    def test_fit_meta_kriging_on_proxy(self):
+        """Config-4 shape: the q=2 proxy through the full pipeline
+        (logit link, the reference's own; K-subset fan-out)."""
+        from smk_tpu import SMKConfig, fit_meta_kriging
+
+        data = make_ebird_proxy(n=384, seed=5)
+        t = 6
+        cfg = SMKConfig(
+            n_subsets=4, n_samples=60, burn_in_frac=0.5, link="logit",
+            n_quantiles=16, resample_size=40,
+        )
+        res = fit_meta_kriging(
+            jax.random.key(0),
+            data.y[:-t], data.x[:-t], data.coords[:-t],
+            data.coords[-t:], data.x[-t:],
+            config=cfg,
+        )
+        p = np.asarray(res.p_samples)
+        assert np.isfinite(p).all() and (p >= 0).all() and (p <= 1).all()
+        assert np.isfinite(np.asarray(res.param_grid)).all()
