@@ -1,0 +1,161 @@
+"""Profile the north-star slice (BASELINE config 5, one chip's share)
+knob-by-knob on real hardware.
+
+For each solver variant this times ONE compiled burn-in chunk of the
+K-vmapped sampler at m=3906, K=32 (chunked dispatch — the same program
+bench.py times end-to-end) and reports:
+
+  - compile seconds (the AOT cost the bench gate must budget for)
+  - seconds per chunk / per iteration
+  - the linear extrapolation to the full 5000-iteration budget
+
+Variants isolate the two scale-dominant costs (SURVEY.md §2.3): the
+CG u-update (bandwidth-bound m x m matvec streams) via cg_iters /
+cg_matvec_dtype, and the phi-MH batched Cholesky (the one remaining
+O(m^3) factorization) via phi_update_every.
+
+Run on TPU (nothing else may touch the chip — the tunnel is
+single-client):  python scripts/profile_slice.py [chunk_iters]
+Results land in PROFILE_SLICE.txt-style stdout; commit the output.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from smk_tpu.config import SMKConfig
+from smk_tpu.models.probit_gp import SpatialGPSampler
+from smk_tpu.parallel.executor import DATA_AXES, stacked_subset_data
+from smk_tpu.parallel.partition import Partition
+from smk_tpu.utils.tracing import device_sync
+
+M = int(os.environ.get("PROF_M", 3906))
+K = int(os.environ.get("PROF_K", 32))
+Q = int(os.environ.get("PROF_Q", 1))
+T = int(os.environ.get("PROF_T", 64))
+N_SAMPLES = int(os.environ.get("PROF_SAMPLES", 5000))
+
+
+def make_data(rng):
+    part = Partition(
+        y=jnp.asarray(rng.integers(0, 2, (K, M, Q)), jnp.float32),
+        x=jnp.asarray(rng.normal(size=(K, M, Q, 2)), jnp.float32),
+        coords=jnp.asarray(rng.uniform(size=(K, M, 2)), jnp.float32),
+        mask=jnp.ones((K, M), jnp.float32),
+        index=jnp.zeros((K, M), jnp.int32),
+    )
+    ct = jnp.asarray(rng.uniform(size=(T, 2)), jnp.float32)
+    xt = jnp.asarray(rng.normal(size=(T, Q, 2)), jnp.float32)
+    return stacked_subset_data(part, ct, xt)
+
+
+def profile_variant(name, overrides, data, chunk_iters):
+    cfg = SMKConfig(
+        n_subsets=K,
+        n_samples=N_SAMPLES,
+        cov_model="exponential",
+        **overrides,
+    )
+    model = SpatialGPSampler(cfg, weight=1)
+    keys = jax.random.split(jax.random.key(0), K)
+    init = jax.jit(
+        jax.vmap(
+            lambda kk, d: model.init_state(kk, d, None),
+            in_axes=(0, DATA_AXES),
+        )
+    )(keys, data)
+    jax.block_until_ready(init)
+
+    fn = jax.jit(
+        jax.vmap(
+            lambda d, s, t: model.burn_chunk(d, s, t, chunk_iters),
+            in_axes=(DATA_AXES, 0, None),
+        ),
+        donate_argnums=(1,),
+    )
+    t0 = time.time()
+    compiled = fn.lower(data, init, jnp.asarray(0)).compile()
+    compile_s = time.time() - t0
+
+    # two timed chunks, each synced by a host element fetch: donated
+    # outputs alias input buffers the local runtime already considers
+    # ready, so block_until_ready alone times the DISPATCH, not the
+    # work (utils/tracing.py device_sync)
+    t0 = time.time()
+    state = compiled(data, init, jnp.asarray(0))
+    device_sync(state.beta)
+    first_s = time.time() - t0
+    t0 = time.time()
+    state = compiled(data, state, jnp.asarray(chunk_iters))
+    device_sync(state.beta)
+    second_s = time.time() - t0
+
+    per_iter = second_s / chunk_iters
+    row = {
+        "variant": name,
+        "m": M, "K": K, "q": Q,
+        "chunk_iters": chunk_iters,
+        "compile_s": round(compile_s, 1),
+        "first_chunk_s": round(first_s, 2),
+        "chunk_s": round(second_s, 2),
+        "ms_per_iter": round(per_iter * 1e3, 2),
+        "extrap_5000_s": round(per_iter * N_SAMPLES, 1),
+        **overrides,
+    }
+    print(json.dumps(row), flush=True)
+    del state, init
+    return row
+
+
+def main():
+    chunk_iters = int(sys.argv[1]) if len(sys.argv) > 1 else 50
+    rng = np.random.default_rng(0)
+    data = make_data(rng)
+    jax.block_until_ready(data)
+    print(json.dumps({
+        "device": str(jax.devices()[0]),
+        "m": M, "K": K, "q": Q, "chunk_iters": chunk_iters,
+    }), flush=True)
+
+    variants = [
+        ("cg32_bf16_phi2", dict(u_solver="cg", cg_iters=32,
+                               cg_matvec_dtype="bfloat16",
+                               phi_update_every=2)),
+        # phi never updates inside the chunk -> pure CG + augmentation
+        ("cg32_bf16_nophi", dict(u_solver="cg", cg_iters=32,
+                        cg_matvec_dtype="bfloat16",
+                        phi_update_every=10_000)),
+        # phi every sweep -> isolates the Cholesky increment
+        ("cg32_bf16_phi1", dict(u_solver="cg", cg_iters=32,
+                             cg_matvec_dtype="bfloat16",
+                             phi_update_every=1)),
+        # CG depth halved
+        ("cg16_bf16_phi2", dict(u_solver="cg", cg_iters=16,
+                      cg_matvec_dtype="bfloat16",
+                      phi_update_every=2)),
+        # fp32 matvec (bandwidth doubled) for the bf16 win measurement
+        ("cg32_fp32_phi2", dict(u_solver="cg", cg_iters=32,
+                           cg_matvec_dtype="float32",
+                           phi_update_every=2)),
+        # bench r3 default: the measured mixing/wall-clock sweet spot
+        ("cg32_bf16_phi4_BENCH_DEFAULT_r3", dict(u_solver="cg", cg_iters=32,
+                             cg_matvec_dtype="bfloat16",
+                             phi_update_every=4)),
+    ]
+    for name, ov in variants:
+        try:
+            profile_variant(name, ov, data, chunk_iters)
+        except Exception as e:  # keep going: partial data beats none
+            print(json.dumps({"variant": name, "error": repr(e)}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -28,7 +28,11 @@ from smk_tpu.ops.quantiles import (
     inverse_cdf_resample,
 )
 from smk_tpu.parallel.combine import combine_quantile_grids
-from smk_tpu.parallel.executor import fit_subsets_sharded, fit_subsets_vmap
+from smk_tpu.parallel.executor import (
+    fit_subsets_sharded,
+    fit_subsets_vmap,
+    make_mesh,
+)
 from smk_tpu.parallel.partition import random_partition
 from smk_tpu.utils.tracing import PhaseTimes, device_sync, phase_timer
 
@@ -130,19 +134,32 @@ def fit_meta_kriging(
     sharded: bool = False,
     mesh=None,
     chunk_size: Optional[int] = None,
+    chunk_iters: Optional[int] = None,
     checkpoint_path: Optional[str] = None,
     checkpoint_every: int = 500,
+    progress=None,
 ) -> MetaKrigingResult:
     """Full spatial-meta-kriging pipeline.
 
     y: (n, q) binary/binomial counts; x: (n, q, p) designs;
     coords: (n, d); coords_test: (t, d); x_test: (t, q, p);
     weight: binomial trial count (reference `weight`, R:53,81).
-    checkpoint_path: if set, the subset fits run through the
-    checkpointed executor (parallel/recovery.py) — sampler state +
-    kept draws are saved every `checkpoint_every` iterations and an
-    interrupted call resumes from the file (mutually exclusive with
-    `sharded` for now).
+
+    Execution composes orthogonally (all combinations are valid —
+    the reference's all-or-nothing foreach, R:102-114, has no
+    equivalent of any of these):
+
+    - ``sharded``/``mesh``: K subsets laid out over the device mesh.
+    - ``chunk_size``: lax.map over K-chunks to bound resident memory.
+    - ``chunk_iters``: run the MCMC as a host loop of this many
+      iterations per compiled dispatch (required at scales where a
+      single whole-run dispatch cannot survive the execution
+      environment); implied by ``checkpoint_path``/``progress``.
+    - ``checkpoint_path``: atomic checkpoint every chunk (every
+      ``checkpoint_every`` iterations unless ``chunk_iters`` is set);
+      an interrupted call resumes bit-exactly.
+    - ``progress``: per-chunk callback(dict) with iteration count and
+      running phi acceptance (reference n.report parity, R:84).
     """
     cfg = config or SMKConfig()
     times = PhaseTimes()
@@ -172,25 +189,27 @@ def fit_meta_kriging(
 
     model = SpatialGPSampler(cfg, weight=weight)
     with phase_timer(times, "subset_fits"):
-        if checkpoint_path is not None:
-            if sharded:
-                raise ValueError(
-                    "checkpoint_path and sharded are mutually exclusive"
-                )
-            if chunk_size is not None:
-                raise ValueError(
-                    "checkpoint_path does not support chunk_size yet — "
-                    "the checkpointed executor vmaps all K subsets at "
-                    "once; drop one of the two arguments"
-                )
-            from smk_tpu.parallel.recovery import fit_subsets_checkpointed
+        if (
+            checkpoint_path is not None
+            or chunk_iters is not None
+            or progress is not None
+        ):
+            from smk_tpu.parallel.recovery import fit_subsets_chunked
 
-            results = fit_subsets_checkpointed(
+            # an explicit mesh implies sharded execution, with or
+            # without the sharded flag (both branches agree on this)
+            run_mesh = mesh
+            if sharded and run_mesh is None:
+                run_mesh = make_mesh(axis=cfg.mesh_axis)
+            results = fit_subsets_chunked(
                 model, part, coords_test, x_test, k_fit, beta_init,
+                chunk_iters=chunk_iters or checkpoint_every,
                 checkpoint_path=checkpoint_path,
-                chunk_iters=checkpoint_every,
+                mesh=run_mesh,
+                chunk_size=chunk_size,
+                progress=progress,
             )
-        elif sharded:
+        elif sharded or mesh is not None:
             results = fit_subsets_sharded(
                 model, part, coords_test, x_test, k_fit, beta_init,
                 mesh=mesh, chunk_size=chunk_size,

@@ -28,16 +28,36 @@ class PriorConfig:
     - phi: Unif(phi_min, phi_max) per response — reference "phi.Unif"
       with bounds 3/0.75 and 3/0.25 (effective range 0.25..0.75 on a
       unit domain).
-    - A (coregionalization): independent N(0, a_scale^2) on the
-      lower-triangular elements. The reference places IW(q, 0.1 I) on
-      K = A A^T and updates A by random-walk MH (:64); a conjugate
-      normal update on A's rows is the TPU-friendly equivalent (the
-      cross-covariance is still fully learned).
+    - A (coregionalization): two options via ``a_prior``.
+      "normal": independent N(0, a_scale^2) on the lower-triangular
+      elements with exact conjugate row updates — the TPU-friendly
+      redesign (the cross-covariance is still fully learned).
+      "invwishart": the reference's own prior, K = A A^T ~
+      IW(iw_df, iw_scale * I) (:64, spBayes "K.IW") — implemented as
+      an independence-MH step whose proposal is the conjugate normal
+      conditional, so the likelihood cancels in the ratio (no tuning,
+      no extra O(m) work). Prefer "invwishart" for weakly identified
+      binary data: with only separable 0/1 responses the latent scale
+      K is barely likelihood-identified, and the near-flat normal
+      prior (a_scale = 10) lets long chains drift to huge K where the
+      IW prior's shrinkage (mode ~ iw_scale/(iw_df+q+1)) holds the
+      reference's posterior in place — see
+      tests/test_sampler.py::TestKPriorParity and
+      scripts/k_prior_parity.py.
     """
 
     phi_min: float = 3.0 / 0.75
     phi_max: float = 3.0 / 0.25
+    # Default "invwishart" — the reference's own K-prior (R:64) and
+    # the stable choice on weakly identified binary data (the smoke
+    # pipeline's K median drifts to ~30 under "normal"); "normal"
+    # remains the pure-conjugate option for informative data.
+    a_prior: str = "invwishart"
     a_scale: float = 10.0
+    # IW(iw_df, iw_scale * I); iw_df = 0 means "use q" (the reference
+    # sets df = q and scale 0.1, MetaKriging_BinaryResponse.R:64)
+    iw_df: float = 0.0
+    iw_scale: float = 0.1
     # Near-flat N(0, beta_scale^2) prior on beta: the reference's
     # "beta.Flat" is the beta_scale -> inf limit; the finite default
     # adds a 1e-4 ridge to the conjugate update's precision, which
@@ -103,11 +123,26 @@ class SMKConfig:
     # cg_matvec_dtype="bfloat16" stores the matrix half-width and
     # halves the traffic; CG vectors and accumulation stay float32.
     # The bfloat16 matrix perturbs correlations at ~2^-8 relative —
-    # validated posterior-equivalent to the exact path in
-    # tests/test_sampler.py::TestSolverEquivalence.
+    # validated posterior-equivalent to the exact path at m=160
+    # (tests/test_sampler.py::TestSolverEquivalence) and solution-
+    # equivalent vs a dense fp32 Cholesky at m=1024
+    # (tests/test_ops.py::TestCGModerateM); at larger m the operator's
+    # positive-definiteness margin rests on the jittered diagonal plus
+    # the O(1) noise variances d, and bench.py reports a measured CG
+    # residual-norm diagnostic (cg_rel_residual) at full bench scale.
     u_solver: str = "chol"
     cg_iters: int = 64
     cg_matvec_dtype: str = "float32"
+
+    # Blocked-GEMM Cholesky for the phi-MH proposal factorization (the
+    # one remaining O(m^3) kernel): 0 = XLA's native cholesky; > 0 =
+    # ops/chol.py blocked_cholesky with this block size (the same
+    # factorization, reformulated so the flops live in large GEMMs).
+    # On v5e the native kernel measured FASTER (96 vs 119 ms at
+    # (32, 3906, 3906), scan-amortized), so 0 is the default; the
+    # blocked form is for backends whose native cholesky is
+    # panel-bound.
+    chol_block_size: int = 0
 
     # Pólya-Gamma series truncation for the logit link: omega is drawn
     # from the defining infinite series cut at this many terms with
@@ -124,7 +159,18 @@ class SMKConfig:
     # the whole sampler trace: "highest" (fp32-equivalent passes, the
     # fidelity floor used by tests) or "tensorfloat32"/"bfloat16" to
     # trade precision for MXU throughput in the CG matvecs.
+    # Cholesky/CG diagonal jitter on the m x m correlation. The
+    # EFFECTIVE jitter is max(jitter, jitter_per_m * m): fp32
+    # factorization roundoff grows ~ m * eps * ||R||, and random
+    # partitions of large point sets contain near-duplicate (even
+    # fp32-identical) locations whose correlation rows are linearly
+    # dependent — measured at m=3906 on v5e, jitter 1e-5 leaves
+    # 12-18/32 subsets with a non-finite factor while 3e-4 factors
+    # 32/32 across the phi prior range (jitter_probe, r3). The scaled
+    # default gives 1e-5 below m=40, ~1e-4 at m=500, ~1e-3 at m=3906
+    # — a <=0.1% nugget on a unit-variance prior.
     jitter: float = 1e-5
+    jitter_per_m: float = 2.5e-7
     mask_noise_var: float = 1e8  # pseudo noise variance on padded rows
     dtype: str = "float32"
     matmul_precision: str = "highest"
@@ -136,6 +182,14 @@ class SMKConfig:
     priors: PriorConfig = dataclasses.field(default_factory=PriorConfig)
 
     def __post_init__(self):
+        if self.priors.a_prior not in ("normal", "invwishart"):
+            raise ValueError(
+                "priors.a_prior must be 'normal' or 'invwishart'"
+            )
+        if self.priors.iw_df < 0 or self.priors.iw_scale <= 0:
+            raise ValueError(
+                "priors.iw_df must be >= 0 (0 = use q) and iw_scale > 0"
+            )
         if self.cov_model not in COV_MODELS:
             raise ValueError(f"cov_model must be one of {COV_MODELS}")
         if self.link not in LINKS:
@@ -150,6 +204,12 @@ class SMKConfig:
             raise ValueError(
                 "cg_matvec_dtype must be 'float32' or 'bfloat16'"
             )
+        if self.jitter <= 0 or self.jitter_per_m < 0:
+            raise ValueError(
+                "jitter must be > 0 and jitter_per_m >= 0"
+            )
+        if self.chol_block_size < 0:
+            raise ValueError("chol_block_size must be >= 0 (0 = XLA)")
         if self.phi_update_every < 1:
             raise ValueError("phi_update_every must be >= 1")
         if not 0.0 < self.phi_target_accept < 1.0:
@@ -169,6 +229,11 @@ class SMKConfig:
             raise ValueError(
                 f"unknown matmul_precision {self.matmul_precision!r}"
             )
+
+    def effective_jitter(self, m: int) -> float:
+        """Diagonal jitter for an m x m correlation factorization —
+        the scale-aware floor (see the jitter field comment)."""
+        return max(self.jitter, self.jitter_per_m * m)
 
     @property
     def n_burn_in(self) -> int:

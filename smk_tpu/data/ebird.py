@@ -49,8 +49,14 @@ class PresenceAbsenceData(NamedTuple):
 
 
 def _standardize(v: np.ndarray) -> np.ndarray:
-    sd = v.std()
-    return (v - v.mean()) / (sd if sd > 0 else 1.0)
+    """Column-wise z-scoring (axis 0). For a (n, p) covariate matrix
+    each column is centered/scaled by ITS OWN mean/std — mixed-scale
+    real covariates (effort hours ~2 vs elevation ~500) must not share
+    one global scale, or the GLM warm start and prior calibration see
+    wildly mis-scaled columns. Constant columns pass through centered."""
+    v = np.asarray(v, np.float64)
+    sd = v.std(axis=0)
+    return (v - v.mean(axis=0)) / np.where(sd > 0, sd, 1.0)
 
 
 def load_presence_absence_csv(

@@ -62,12 +62,13 @@ from jax import lax
 
 from smk_tpu.config import SMKConfig
 from smk_tpu.ops.chol import (
+    blocked_cholesky,
     chol_logdet,
     chol_solve,
     jittered_cholesky,
     tri_solve,
 )
-from smk_tpu.ops.cg import cg_solve
+from smk_tpu.ops.cg import cg_solve, shifted_correlation_operator
 from smk_tpu.ops.distance import cross_distance, pairwise_distance
 from smk_tpu.ops.kernels import correlation
 from smk_tpu.ops.polya_gamma import sample_pg
@@ -158,6 +159,17 @@ class SpatialGPSampler:
         self.config = config
         self.weight = int(weight)
 
+    def _chol_r(self, r: jnp.ndarray) -> jnp.ndarray:
+        """Factor the (stacked) m x m correlation — through the
+        blocked-GEMM kernel when config.chol_block_size > 0, under the
+        scale-aware jitter (fp32 roundoff grows with m; near-duplicate
+        partition points make R rank-deficient — config.jitter_per_m)."""
+        cfg = self.config
+        jit_eff = cfg.effective_jitter(r.shape[-1])
+        if cfg.chol_block_size > 0:
+            return blocked_cholesky(r, jit_eff, cfg.chol_block_size)
+        return jittered_cholesky(r, jit_eff)
+
     # ------------------------------------------------------------------
     # Initialization
     # ------------------------------------------------------------------
@@ -186,7 +198,7 @@ class SpatialGPSampler:
             u=jnp.zeros((m, q), dtype),
             a=jnp.eye(q, dtype=dtype),
             phi=phi0,
-            chol_r=jittered_cholesky(r0, self.config.jitter),
+            chol_r=self._chol_r(r0),
             key=key,
             phi_accept=jnp.zeros((q,), dtype),
             phi_log_step=jnp.full(
@@ -208,6 +220,10 @@ class SpatialGPSampler:
         key, kz, kb, kphi, kprop, ku_prior, ku_noise, ka, kpred = jax.random.split(
             state.key, 9
         )
+        # scale-aware jitter for every m x m factorization/solve — it
+        # MUST match what _chol_r factors (the CG operator and the
+        # carried factor describe the same matrix)
+        jit_eff = cfg.effective_jitter(m)
 
         beta, u, a, phi = state.beta, state.u, state.a, state.phi
 
@@ -259,7 +275,7 @@ class SpatialGPSampler:
                 r = masked_correlation(
                     dist[None], phis[:, None, None], mask, cfg.cov_model
                 )
-                return jittered_cholesky(r, cfg.jitter)
+                return self._chol_r(r)
 
             step = jnp.exp(state.phi_log_step)
             t_cur = jnp.log((phi - lo) / (hi - phi))
@@ -359,24 +375,14 @@ class SpatialGPSampler:
                     if cfg.cg_matvec_dtype == "bfloat16"
                     else dtype
                 )
-                r_mv = masked_correlation(
-                    dist, phi[j], mask, cfg.cov_model
-                ).astype(mv_dtype)
-
-                def apply_r(x, r_mv=r_mv):
-                    return jnp.matmul(
-                        r_mv,
-                        x.astype(mv_dtype),
-                        preferred_element_type=dtype,
-                    ).astype(dtype)
-
-                def mv(x):
-                    return apply_r(x) + (cfg.jitter + d_vec) * x
-
-                s = cg_solve(
-                    mv, rhs_vec, cfg.cg_iters, diag=1.0 + cfg.jitter + d_vec
+                mv, diag, apply_r = shifted_correlation_operator(
+                    masked_correlation(dist, phi[j], mask, cfg.cov_model),
+                    jit_eff + d_vec,
+                    mv_dtype,
+                    dtype,
                 )
-                u = u.at[:, j].set(u_star + apply_r(s) + cfg.jitter * s)
+                s = cg_solve(mv, rhs_vec, cfg.cg_iters, diag=diag)
+                u = u.at[:, j].set(u_star + apply_r(s) + jit_eff * s)
             else:
                 # exact dense path: R rebuilt elementwise from the
                 # distance matrix — O(m^2), not the O(m^3) L @ L^T.
@@ -384,31 +390,73 @@ class SpatialGPSampler:
                 # prior covariance the carried chol_r factors).
                 r_mat = masked_correlation(
                     dist, phi[j], mask, cfg.cov_model
-                ) + cfg.jitter * jnp.eye(m, dtype=dtype)
+                ) + jit_eff * jnp.eye(m, dtype=dtype)
                 chol_m = jittered_cholesky(r_mat + jnp.diag(d_vec), 0.0)
                 s = chol_solve(chol_m, rhs_vec)
                 u = u.at[:, j].set(u_star + r_mat @ s)
 
-        # --- 5. A | z, beta, U (conjugate rows, lower-triangular) -----
-        # Row l regresses e0[:, l] on U with per-location precision
-        # womega[:, l]; each row gets its own omega-weighted Gram.
-        s_all = jnp.einsum("mi,ml,mj->lij", u, womega, u)  # (q, q, q)
-        rhs_all = jnp.einsum("mi,ml->li", u, womega * e0)  # (q, q)
+        # --- 5. A | z, beta, U (lower-triangular coregionalization) ---
+        # Row l of A only multiplies components j <= l (w_l = U_{:,:l+1}
+        # a_l), so each row's free entries get an EXACT conjugate
+        # Gaussian conditional: an omega-weighted regression of
+        # e0[:, l] on the first l+1 component columns, under the
+        # N(0, a_scale^2) working prior. Rows are conditionally
+        # independent given U. q is small and static, so the ragged
+        # row dimension is a plain unrolled Python loop.
         prior_prec = 1.0 / jnp.asarray(cfg.priors.a_scale, dtype) ** 2
-        row_idx = jnp.arange(q)
-        # entries k > l are pinned to ~0 by a huge prior precision —
-        # one batched (q, q) solve replaces a ragged per-row loop
-        pin = jnp.where(row_idx[None, :] <= row_idx[:, None], prior_prec, 1e12)
+        ka_rows = jax.random.split(ka, q + 1)
+        a_new = jnp.zeros_like(a)
+        for l in range(q):
+            u_sub = u[:, : l + 1]  # (m, l+1)
+            wom_l = womega[:, l]
+            prec = u_sub.T @ (wom_l[:, None] * u_sub) + prior_prec * jnp.eye(
+                l + 1, dtype=dtype
+            )
+            chol_p = jittered_cholesky(prec, cfg.jitter)
+            mean_l = chol_solve(chol_p, u_sub.T @ (wom_l * e0[:, l]))
+            z = jax.random.normal(ka_rows[l], (l + 1,), dtype)
+            row = mean_l + tri_solve(chol_p, z, trans=True)
+            a_new = a_new.at[l, : l + 1].set(row)
 
-        def draw_row(s_l, rhs_l, pin_l, key_l):
-            p_l = s_l + jnp.diag(pin_l)
-            chol_p = jittered_cholesky(p_l, cfg.jitter)
-            mean_l = chol_solve(chol_p, rhs_l)
-            z = jax.random.normal(key_l, (q,), dtype)
-            return mean_l + tri_solve(chol_p, z, trans=True)
+        if cfg.priors.a_prior == "invwishart":
+            # Reference-parity prior: K = A A^T ~ IW(nu, s I)
+            # (MetaKriging_BinaryResponse.R:64, spBayes "K.IW"). The
+            # conjugate draw above is an *independence proposal* from
+            # prop(A') ~ L(A') N(A'; 0, a_scale^2): in the MH ratio
+            #   [L(A') pIW(A') / L(A) pIW(A)] * [prop(A)/prop(A')]
+            # the likelihood cancels, leaving only prior densities —
+            # an exact IW-on-K update at the cost of two tiny density
+            # evaluations, no tuning, no extra O(m) work.
+            nu = cfg.priors.iw_df if cfg.priors.iw_df > 0 else q
+            s_iw = jnp.asarray(cfg.priors.iw_scale, dtype)
 
-        a_rows = jax.vmap(draw_row)(s_all, rhs_all, pin, jax.random.split(ka, q))
-        a = jnp.tril(a_rows)
+            def log_prior_ratio(a_mat):
+                # log pIW(K(A)) + log|dK/dA| - log pN(A), dropping
+                # A-independent constants.
+                diag = jnp.abs(jnp.diagonal(a_mat)) + 1e-30
+                # |K| = prod diag^2; Jacobian = 2^q prod diag^(q-i+1)
+                jac = jnp.sum(
+                    (q - jnp.arange(q)).astype(dtype) * jnp.log(diag)
+                )
+                log_det_k = 2.0 * jnp.sum(jnp.log(diag))
+                a_inv = tri_solve(a_mat, jnp.eye(q, dtype=dtype))
+                tr_psi_kinv = s_iw * jnp.sum(a_inv * a_inv)
+                lp_iw = (
+                    -0.5 * (nu + q + 1) * log_det_k - 0.5 * tr_psi_kinv
+                )
+                tril_r_, tril_c_ = jnp.tril_indices(q)
+                lp_n = -0.5 * prior_prec * jnp.sum(
+                    a_mat[tril_r_, tril_c_] ** 2
+                )
+                return lp_iw + jac - lp_n
+
+            log_alpha = log_prior_ratio(a_new) - log_prior_ratio(a)
+            acc_a = jnp.log(
+                jax.random.uniform(ka_rows[q], (), dtype, minval=1e-12)
+            ) < log_alpha
+            a = jnp.where(acc_a, a_new, a)
+        else:
+            a = a_new
 
         new_state = SamplerState(
             beta=beta, u=u, a=a, phi=phi, chol_r=chol_r, key=key,
@@ -433,7 +481,10 @@ class SpatialGPSampler:
             alpha = tri_solve(l_j, u_j)  # (m,)
             cond_mean = v.T @ alpha
             cond_cov = rt_j - v.T @ v
-            chol_c = jittered_cholesky(cond_cov, cfg.jitter)
+            # jitter at the m-derived scale: cond_cov's entries come
+            # from m-length fp32 contractions, whose roundoff (not t)
+            # sets the PD margin here
+            chol_c = jittered_cholesky(cond_cov, jit_eff)
             z = jax.random.normal(key_j, (t_test,), dtype)
             return cond_mean + chol_c @ z
 

@@ -24,6 +24,35 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def shifted_correlation_operator(r, shift, matvec_dtype, acc_dtype):
+    """The sampler's u-update operator x -> R x + shift * x, with R
+    stored in ``matvec_dtype`` (bfloat16 halves the HBM stream that
+    dominates the solve) and fp32 accumulation.
+
+    Single source of truth for the CG system: the Gibbs step
+    (models/probit_gp.py step 4), the bench's measured residual
+    diagnostic and the moderate-m solver tests all build the operator
+    here, so solver-health numbers always describe the system the
+    sampler actually solves.
+
+    Returns (matvec, jacobi_diag, apply_r) where jacobi_diag is the
+    operator's diagonal (unit correlation diagonal + shift) for
+    preconditioning and apply_r applies R alone (the Matheron
+    back-multiply).
+    """
+    r_mv = r.astype(matvec_dtype)
+
+    def apply_r(x):
+        return jnp.matmul(
+            r_mv, x.astype(matvec_dtype), preferred_element_type=acc_dtype
+        ).astype(acc_dtype)
+
+    def matvec(x):
+        return apply_r(x) + shift * x
+
+    return matvec, 1.0 + shift, apply_r
+
+
 def cg_solve(
     matvec: Callable[[jnp.ndarray], jnp.ndarray],
     b: jnp.ndarray,

@@ -28,6 +28,82 @@ def jittered_cholesky(mat: jnp.ndarray, jitter: float = 1e-5) -> jnp.ndarray:
     return jnp.tril(lax.linalg.cholesky(mat + jitter * eye))
 
 
+def blocked_cholesky(
+    mat: jnp.ndarray, jitter: float = 0.0, block_size: int = 512
+) -> jnp.ndarray:
+    """Lower Cholesky factor via a left-looking blocked algorithm whose
+    flops live in large batched GEMMs.
+
+    The result is the same factorization as lax.linalg.cholesky, not
+    an approximation: only the summation order of fp32 GEMM
+    accumulations differs. Left-looking, ~all of the m^3/3 flops
+    become two GEMMs per block column (the Schur-complement update and
+    the panel scaling by the explicit inverse of the b x b diagonal
+    factor).
+
+    Measured reality check (v5e, scan-amortized, (32, 3906, 3906)
+    fp32): XLA's native cholesky 96 ms (6.6 eff-TFLOP/s), this blocked
+    form 119 ms at block 512 — XLA's native kernel is already
+    GEMM-limited on this chip, so the sampler keeps it as the default
+    (config.chol_block_size = 0) and this op stands as the measured
+    alternative for backends where the native kernel IS panel-bound
+    (the candidate replacement for spBayes's per-iteration dpotrf,
+    SURVEY.md §2.3).
+
+    mat: (..., m, m) SPD; m is padded internally to a block_size
+    multiple with identity (padding factors to identity and is sliced
+    away). The b x b diagonal blocks still go through XLA's cholesky —
+    at b=512 they are a negligible share of the work.
+    """
+    m = mat.shape[-1]
+    if m <= block_size:
+        return jittered_cholesky(mat, jitter)
+    if jitter:
+        mat = mat + jitter * jnp.eye(m, dtype=mat.dtype)
+    nb = -(-m // block_size)
+    mp = nb * block_size
+    if mp != m:
+        batch = mat.shape[:-2]
+        pad = jnp.zeros(batch + (m, mp - m), mat.dtype)
+        eye_pad = jnp.broadcast_to(
+            jnp.eye(mp - m, dtype=mat.dtype), batch + (mp - m, mp - m)
+        )
+        top = jnp.concatenate([mat, pad], axis=-1)
+        bot = jnp.concatenate(
+            [jnp.swapaxes(pad, -1, -2), eye_pad], axis=-1
+        )
+        mat = jnp.concatenate([top, bot], axis=-2)
+
+    b = block_size
+    eye_b = jnp.eye(b, dtype=mat.dtype)
+    l_full = jnp.zeros_like(mat)
+    for k in range(nb):
+        lo, hi = k * b, (k + 1) * b
+        # Schur complement of block column k against the factored
+        # prefix: S = A[lo:, lo:hi] - L[lo:, :lo] @ L[lo:hi, :lo]^T
+        s = mat[..., lo:, lo:hi]
+        if k > 0:
+            s = s - l_full[..., lo:, :lo] @ jnp.swapaxes(
+                l_full[..., lo:hi, :lo], -1, -2
+            )
+        l_kk = jnp.tril(lax.linalg.cholesky(s[..., :b, :]))
+        l_col = l_kk
+        if hi < mp:
+            # panel scale as a GEMM: X L_kk^T = S_below  =>
+            # X = S_below @ (L_kk^{-1})^T; the explicit b x b
+            # triangular inverse keeps this on the MXU instead of a
+            # tall skinny triangular solve
+            inv_kk = solve_triangular(
+                l_kk, jnp.broadcast_to(eye_b, l_kk.shape), lower=True
+            )
+            l_col = jnp.concatenate(
+                [l_kk, s[..., b:, :] @ jnp.swapaxes(inv_kk, -1, -2)],
+                axis=-2,
+            )
+        l_full = l_full.at[..., lo:, lo:hi].set(l_col)
+    return l_full[..., :m, :m]
+
+
 def tri_solve(chol_l: jnp.ndarray, b: jnp.ndarray, *, trans: bool = False) -> jnp.ndarray:
     """Solve L x = b (or L^T x = b when trans) for lower-triangular L."""
     return solve_triangular(chol_l, b, lower=True, trans=1 if trans else 0)

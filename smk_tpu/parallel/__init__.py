@@ -15,6 +15,7 @@ from smk_tpu.parallel.combine import (
 )
 from smk_tpu.parallel.recovery import (
     fit_subsets_checkpointed,
+    fit_subsets_chunked,
     find_failed_subsets,
     rerun_subsets,
 )
@@ -25,6 +26,7 @@ __all__ = [
     "fit_subsets_vmap",
     "fit_subsets_sharded",
     "fit_subsets_checkpointed",
+    "fit_subsets_chunked",
     "find_failed_subsets",
     "rerun_subsets",
     "make_mesh",

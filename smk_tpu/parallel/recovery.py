@@ -40,6 +40,24 @@ from smk_tpu.parallel.partition import Partition
 from smk_tpu.utils.checkpoint import load_pytree, save_pytree
 
 
+# Checkpoint format version. v2 added the run-identity fingerprint;
+# v3 the explicit iteration counter (burn-in chunks checkpoint too). A
+# bump invalidates older files with a clear error instead of a generic
+# structure mismatch.
+CKPT_VERSION = 3
+
+
+def _key_bytes(key) -> bytes:
+    """Raw bytes of a PRNG key, accepting both typed keys and legacy
+    raw uint32 key arrays (jax.random.split handles both; the
+    fingerprint must too, or the checkpointed executor would
+    hard-require typed keys that the rest of the fit path doesn't)."""
+    dt = getattr(key, "dtype", None)
+    if dt is not None and jax.dtypes.issubdtype(dt, jax.dtypes.prng_key):
+        return np.asarray(jax.random.key_data(key)).tobytes()
+    return np.ascontiguousarray(key).tobytes()
+
+
 def _run_identity(cfg, key, data, beta_init) -> np.ndarray:
     """Fingerprint of everything that determines the chain: the full
     config (its repr covers every field incl. priors), the fan-out
@@ -48,7 +66,7 @@ def _run_identity(cfg, key, data, beta_init) -> np.ndarray:
     of being silently resumed/returned (two runs differing only in
     cov_model, key, or data have identical array shapes)."""
     crcs = [zlib.crc32(repr(cfg).encode())]
-    crcs.append(zlib.crc32(np.asarray(jax.random.key_data(key)).tobytes()))
+    crcs.append(zlib.crc32(_key_bytes(key)))
     for leaf in jax.tree_util.tree_leaves(data):
         crcs.append(zlib.crc32(np.ascontiguousarray(leaf).tobytes()))
     if beta_init is not None:
@@ -65,7 +83,46 @@ def _init_states(model, keys, data, beta_init):
     )(keys, data)
 
 
-def fit_subsets_checkpointed(
+def _make_chunk_fn(model, kind, length, k, chunk_size):
+    """Compiled one-chunk program: vmap over the K axis, optionally
+    lax.map-chunked over K (``chunk_size`` bounds how many subsets are
+    resident at once — the same memory lever as fit_subsets_vmap), the
+    carried state donated (at north-star scale the duplicated carry
+    would OOM the chip)."""
+    if kind == "burn":
+        body = lambda d, s, t: model.burn_chunk(d, s, t, length)
+    else:
+        body = lambda d, s, t: model.sample_chunk(d, s, t, length)
+    runner = jax.vmap(body, in_axes=(DATA_AXES, 0, None))
+    if chunk_size is None:
+        return jax.jit(runner, donate_argnums=(1,))
+    if k % chunk_size != 0:
+        raise ValueError(f"chunk_size {chunk_size} must divide K={k}")
+    n_chunks = k // chunk_size
+
+    def chunked(data, state, it):
+        batched = data._replace(coords_test=None, x_test=None)
+        args = jax.tree_util.tree_map(
+            lambda a: a.reshape((n_chunks, chunk_size) + a.shape[1:]),
+            (batched, state),
+        )
+
+        def one(args_c):
+            d_c, s_c = args_c
+            d = d_c._replace(
+                coords_test=data.coords_test, x_test=data.x_test
+            )
+            return runner(d, s_c, it)
+
+        out = jax.lax.map(one, args)
+        return jax.tree_util.tree_map(
+            lambda a: a.reshape((k,) + a.shape[2:]), out
+        )
+
+    return jax.jit(chunked, donate_argnums=(1,))
+
+
+def fit_subsets_chunked(
     model: SpatialGPSampler,
     part: Partition,
     coords_test: jnp.ndarray,
@@ -73,17 +130,35 @@ def fit_subsets_checkpointed(
     key: jax.Array,
     beta_init: Optional[jnp.ndarray] = None,
     *,
-    checkpoint_path: str,
     chunk_iters: int = 500,
+    checkpoint_path: Optional[str] = None,
+    mesh=None,
+    chunk_size: Optional[int] = None,
+    progress=None,
     stop_after_chunks: Optional[int] = None,
 ) -> Optional[SubsetResult]:
-    """K-subset fan-out with periodic checkpointing and resume.
+    """Unified chunked K-subset executor: the whole MCMC (burn-in AND
+    sampling) runs as a host loop of ``chunk_iters``-long compiled
+    dispatches — the form that survives the remote-execute tunnel and
+    mid-run kills at north-star scale — composing, orthogonally:
 
-    If ``checkpoint_path`` exists, the run resumes from it (the caller
-    must pass the same data/config/key — config identity is verified
-    from recorded metadata). ``stop_after_chunks`` ends the run early
-    after that many sampling chunks (returning None with the
-    checkpoint on disk) — the hook the kill-and-resume test uses.
+    - ``mesh``: the K axis laid out over a jax.sharding.Mesh (XLA
+      partitions every chunk across devices with zero collectives —
+      the share-nothing SMK property, SURVEY.md §2.2/§5.8);
+    - ``chunk_size``: lax.map over K-chunks inside each dispatch to
+      bound resident memory (same lever as fit_subsets_vmap);
+    - ``checkpoint_path``: atomic .npz checkpoint after every chunk
+      (including burn-in chunks — format v3 carries the global
+      iteration counter); an interrupted call resumes bit-exactly
+      (the PRNG sequence lives in the carried state);
+    - ``progress``: callback(dict) after every chunk — the n.report
+      parity hook (the reference prints acceptance every 10 batches,
+      MetaKriging_BinaryResponse.R:84); receives phase, iteration,
+      n_samples and the running phi acceptance rate.
+
+    ``stop_after_chunks`` ends the run early after that many chunks
+    (burn or sampling), returning None with the checkpoint on disk —
+    the kill-and-resume test hook.
     """
     cfg = model.config
     if chunk_iters < 1:
@@ -91,6 +166,36 @@ def fit_subsets_checkpointed(
     k = part.n_subsets
     data = stacked_subset_data(part, coords_test, x_test)
     keys = jax.random.split(key, k)
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        axis = mesh.axis_names[0]
+        if k % mesh.devices.size != 0:
+            raise ValueError(
+                f"K={k} must be divisible by mesh size {mesh.devices.size}"
+            )
+        shard = NamedSharding(mesh, P(axis))
+        repl = NamedSharding(mesh, P())
+
+        def put(tree, sharded_leading_k=True):
+            return jax.tree_util.tree_map(
+                lambda a: jax.device_put(
+                    a, shard if sharded_leading_k else repl
+                ),
+                tree,
+            )
+
+        data = data._replace(
+            coords=put(data.coords), x=put(data.x), y=put(data.y),
+            mask=put(data.mask),
+            coords_test=put(data.coords_test, False),
+            x_test=put(data.x_test, False),
+        )
+        keys = put(keys)
+    else:
+        put = None
+
     # Shape-only template: the resume branch never needs the real init
     # states (they'd cost K masked-correlation builds + K O(m^3)
     # Choleskys just to be discarded for ckpt["state"]).
@@ -113,16 +218,37 @@ def fit_subsets_checkpointed(
         [cfg.n_samples, cfg.n_burn_in, k, d_par, d_w], np.int64
     )
     ident = _run_identity(cfg, key, data, beta_init)
+    version = np.asarray([CKPT_VERSION], np.int64)
     like = {
         "state": init_like,
         "param_draws": empty_draws()[0],
         "w_draws": empty_draws()[1],
+        "it": np.asarray([0], np.int64),
         "meta": meta,
         "ident": ident,
+        "version": version,
     }
 
-    if os.path.exists(checkpoint_path):
-        ckpt = load_pytree(checkpoint_path, like)
+    if checkpoint_path is not None and os.path.exists(checkpoint_path):
+        try:
+            ckpt = load_pytree(checkpoint_path, like)
+        except ValueError as e:
+            # Older formats fail structure/leaf-count matching; say so
+            # instead of surfacing the generic pytree error.
+            raise ValueError(
+                f"checkpoint {checkpoint_path} does not match the "
+                f"current checkpoint format v{CKPT_VERSION} (v2 added "
+                "run-identity stamping, v3 the iteration counter) — "
+                "it was written by an older build or for a different "
+                "run shape; delete the file or pass a fresh "
+                "checkpoint_path"
+            ) from e
+        if int(np.asarray(ckpt["version"])[0]) != CKPT_VERSION:
+            raise ValueError(
+                f"checkpoint {checkpoint_path} has format version "
+                f"{int(np.asarray(ckpt['version'])[0])}, expected "
+                f"{CKPT_VERSION} — delete the file or re-run"
+            )
         if not np.array_equal(np.asarray(ckpt["meta"]), meta):
             raise ValueError(
                 f"checkpoint {checkpoint_path} was written for a "
@@ -136,67 +262,136 @@ def fit_subsets_checkpointed(
                 "(same shapes, different chain) — delete the file or "
                 "pass a different checkpoint_path"
             )
-        # leaves arrive as numpy (PRNG keys re-wrapped by load_pytree);
-        # jax consumes them directly
+        # leaves arrive as numpy (PRNG keys re-wrapped by load_pytree)
         state = ckpt["state"]
         param_draws = jnp.asarray(ckpt["param_draws"], dtype)
         w_draws = jnp.asarray(ckpt["w_draws"], dtype)
+        it = int(np.asarray(ckpt["it"])[0])
+        if put is not None:
+            state = put(state)
+            param_draws = put(param_draws)
+            w_draws = put(w_draws)
     else:
-        init = _init_states(model, keys, data, beta_init)
-        burn = jax.jit(jax.vmap(model.burn_in, in_axes=(DATA_AXES, 0)))
-        state = burn(data, init)
+        state = _init_states(model, keys, data, beta_init)
         param_draws, w_draws = empty_draws()
+        it = 0
+
+    def save():
+        if checkpoint_path is None:
+            return
         save_pytree(
             checkpoint_path,
             {
                 "state": state,
                 "param_draws": param_draws,
                 "w_draws": w_draws,
+                "it": np.asarray([it], np.int64),
                 "meta": meta,
                 "ident": ident,
+                "version": version,
             },
         )
 
     chunk_fns = {}
 
-    def chunk_fn(n: int):
-        if n not in chunk_fns:
-            chunk_fns[n] = jax.jit(
-                jax.vmap(
-                    lambda d_, s_, t_: model.sample_chunk(d_, s_, t_, n),
-                    in_axes=(DATA_AXES, 0, None),
-                )
+    def chunk_fn(kind: str, n: int):
+        if (kind, n) not in chunk_fns:
+            chunk_fns[kind, n] = _make_chunk_fn(
+                model, kind, n, k, chunk_size
             )
-        return chunk_fns[n]
+        return chunk_fns[kind, n]
 
-    it_next = cfg.n_burn_in + param_draws.shape[1]
+    def report(phase, window_start):
+        if progress is None:
+            return
+        pe = cfg.phi_update_every
+        # phi updates land on global iterations i = 0 (mod pe); the
+        # accept counter covers [window_start, it) — the window since
+        # it was last zeroed (0 during burn-in, n_burn_in during
+        # sampling) — so the rate divides by the updates in THAT
+        # window, not by ceil(it/pe) over the whole run
+        n_updates = max(1, -(-it // pe) - -(-window_start // pe))
+        progress({
+            "phase": phase,
+            "iteration": it,
+            "n_samples": cfg.n_samples,
+            "phi_accept_rate": float(
+                np.mean(np.asarray(state.phi_accept)) / n_updates
+            ),
+        })
+
     chunks_done = 0
-    while it_next < cfg.n_samples:
-        n = min(chunk_iters, cfg.n_samples - it_next)
-        state, (pd, wd) = chunk_fn(n)(data, state, jnp.asarray(it_next))
-        param_draws = jnp.concatenate([param_draws, pd], axis=1)
-        w_draws = jnp.concatenate([w_draws, wd], axis=1)
-        it_next += n
-        save_pytree(
-            checkpoint_path,
-            {
-                "state": state,
-                "param_draws": param_draws,
-                "w_draws": w_draws,
-                "meta": meta,
-                "ident": ident,
-            },
-        )
+    n_burn = cfg.n_burn_in
+    while it < n_burn:
+        n = min(chunk_iters, n_burn - it)
+        state = chunk_fn("burn", n)(data, state, jnp.asarray(it))
+        it += n
+        # report before the boundary reset so the last burn line
+        # carries the full burn-in acceptance, not 0.0
+        report("burn", 0)
+        if it == n_burn:
+            # post-burn-in acceptance accounting, as burn_in() does
+            state = state._replace(
+                phi_accept=jnp.zeros_like(state.phi_accept)
+            )
+        save()
         chunks_done += 1
         if (
             stop_after_chunks is not None
             and chunks_done >= stop_after_chunks
-            and it_next < cfg.n_samples
+            and it < cfg.n_samples
+        ):
+            return None
+
+    while it < cfg.n_samples:
+        n = min(chunk_iters, cfg.n_samples - it)
+        state, (pd, wd) = chunk_fn("samp", n)(
+            data, state, jnp.asarray(it)
+        )
+        param_draws = jnp.concatenate([param_draws, pd], axis=1)
+        w_draws = jnp.concatenate([w_draws, wd], axis=1)
+        it += n
+        report("sample", n_burn)
+        save()
+        chunks_done += 1
+        if (
+            stop_after_chunks is not None
+            and chunks_done >= stop_after_chunks
+            and it < cfg.n_samples
         ):
             return None
 
     finalize = jax.jit(jax.vmap(model.finalize))
     return finalize(state, param_draws, w_draws)
+
+
+def fit_subsets_checkpointed(
+    model: SpatialGPSampler,
+    part: Partition,
+    coords_test: jnp.ndarray,
+    x_test: jnp.ndarray,
+    key: jax.Array,
+    beta_init: Optional[jnp.ndarray] = None,
+    *,
+    checkpoint_path: str,
+    chunk_iters: int = 500,
+    stop_after_chunks: Optional[int] = None,
+    mesh=None,
+    chunk_size: Optional[int] = None,
+    progress=None,
+) -> Optional[SubsetResult]:
+    """K-subset fan-out with periodic checkpointing and resume — the
+    checkpoint-requiring entry point over ``fit_subsets_chunked`` (see
+    its docstring for the full composition semantics)."""
+    return fit_subsets_chunked(
+        model, part, coords_test, x_test, key, beta_init,
+        chunk_iters=chunk_iters,
+        checkpoint_path=checkpoint_path,
+        mesh=mesh,
+        chunk_size=chunk_size,
+        progress=progress,
+        stop_after_chunks=stop_after_chunks,
+    )
 
 
 def find_failed_subsets(results: SubsetResult) -> np.ndarray:

@@ -94,6 +94,32 @@ class TestCsvLoader:
                     ratios.append(db / do)
             assert np.ptp(ratios) < 1e-3  # one global scale factor
 
+    def test_mixed_scale_covariates_standardized_per_column(self, tmp_path):
+        """ADVICE r2 (medium): covariates with wildly different raw
+        scales (effort ~2 vs elevation ~500) must each come out
+        zero-mean/unit-sd — a single global mean/std would leave
+        columns mis-centered with stds orders of magnitude apart."""
+        rng = np.random.default_rng(11)
+        n = 400
+        path = str(tmp_path / "mixed.csv")
+        with open(path, "w") as f:
+            f.write("latitude,longitude,effort_hrs,elevation,sp\n")
+            for i in range(n):
+                f.write(
+                    f"{rng.uniform(40, 41):.6f},{rng.uniform(-3, -2):.6f},"
+                    f"{rng.gamma(2.0, 1.0):.4f},"
+                    f"{rng.normal(500.0, 120.0):.2f},"
+                    f"{int(rng.uniform() < 0.3)}\n"
+                )
+        data = load_presence_absence_csv(
+            path,
+            species_cols=["sp"],
+            covariate_cols=("effort_hrs", "elevation"),
+        )
+        cols = data.x[:, 0, 1:]  # drop the intercept
+        np.testing.assert_allclose(cols.mean(axis=0), 0.0, atol=1e-5)
+        np.testing.assert_allclose(cols.std(axis=0), 1.0, atol=1e-4)
+
     def test_missing_rows_raise(self, tmp_path):
         path = str(tmp_path / "empty.csv")
         with open(path, "w") as f:

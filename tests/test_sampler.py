@@ -14,7 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from smk_tpu.config import SMKConfig
+from smk_tpu.config import PriorConfig, SMKConfig
 from smk_tpu.models.probit_gp import SpatialProbitGP, SubsetData, n_params
 from smk_tpu.ops.chol import jittered_cholesky
 from smk_tpu.ops.distance import pairwise_distance
@@ -239,14 +239,23 @@ def _posteriors_agree(ps_a, ps_b, max_sd=0.75):
 
 class TestSolverEquivalence:
     """The benchmark's scaling-regime settings (bench.py: u_solver=cg,
-    cg_iters=48, phi_update_every=2) must target the same posterior as
+    cg_iters=32, phi_update_every=4) must target the same posterior as
     the exact defaults — this covers the exact env-var config of
     BENCH_r*.json (chains share seeds, so differences isolate the
     solver/schedule)."""
 
     def _fit(self, data, **overrides):
+        # invwishart K-prior (the reference's own, R:64): with purely
+        # binary responses at m=160 the latent scale K is barely
+        # likelihood-identified, and under the near-flat normal-A
+        # prior LONG chains drift to huge K (measured: K median 119
+        # at 3200 iterations) — the comparison here needs the prior
+        # that holds the posterior in place, which is also what
+        # bench.py runs (BENCH_A_PRIOR).
         cfg = SMKConfig(
-            n_subsets=1, n_samples=800, burn_in_frac=0.5, **overrides
+            **{"n_subsets": 1, "n_samples": 800, "burn_in_frac": 0.5,
+               "priors": PriorConfig(a_prior="invwishart"),
+               **overrides}
         )
         model = SpatialProbitGP(cfg, weight=1)
         st = model.init_state(jax.random.key(17), data)
@@ -270,6 +279,20 @@ class TestSolverEquivalence:
         res = self._fit(data, phi_update_every=2)
         _posteriors_agree(ps_exact, np.asarray(res.param_samples))
 
+    def test_phi_update_every_4_matches(self, shared):
+        """The r3 bench schedule: phi Metropolis-updated every 4th
+        sweep (a valid deterministic-scan Gibbs schedule) must target
+        the same posterior; the wall-clock trade is measured in
+        PROFILE_SLICE_r03.jsonl (453 s vs 636 s at the config-5
+        slice). Scale-appropriate verification at m=1953 lives in
+        scripts/verify_phi_schedule.py + its committed artifact."""
+        data, ps_exact = shared
+        # 4x fewer phi moves per sweep -> run the chain longer so the
+        # phi-median MC error doesn't swamp the comparison (the
+        # schedule slows phi MIXING, it cannot shift the target)
+        res = self._fit(data, phi_update_every=4, n_samples=3200)
+        _posteriors_agree(ps_exact, np.asarray(res.param_samples))
+
     def test_cg_bf16_matvec_matches(self, shared):
         """bfloat16-stored CG matrix (the bandwidth optimization)
         targets the same posterior as the exact solver."""
@@ -282,12 +305,53 @@ class TestSolverEquivalence:
     def test_bench_config_matches(self, shared):
         """The full benchmark combination, exactly as bench.py sets it."""
         data, ps_exact = shared
+        # longer chain for the same reason as the phi_every_4 test:
+        # 1/4 the phi moves per sweep needs ~4x the sweeps for the
+        # phi-median MC error to stay inside the comparison band
         res = self._fit(
             data,
             u_solver="cg",
             cg_iters=32,
             cg_matvec_dtype="bfloat16",
-            phi_update_every=2,
+            phi_update_every=4,
+            n_samples=3200,
         )
         _posteriors_agree(ps_exact, np.asarray(res.param_samples))
         assert 0.2 < float(res.phi_accept_rate[0]) < 0.7
+
+
+class TestKPriorParity:
+    """VERDICT r2 #5 (open since r1): the TPU-friendly conjugate
+    normal-A scheme and the reference's IW(q, 0.1 I)-on-K prior
+    (MetaKriging_BinaryResponse.R:64) must give comparable K
+    posteriors on shared synthetic q=2 data where the likelihood
+    identifies K. (Where it does NOT — purely binary, small m — the
+    priors legitimately differ, which is exactly why bench.py and the
+    solver-equivalence suite run the reference's IW prior; see
+    PriorConfig docstring.) A larger committed-artifact version of
+    this comparison lives in scripts/k_prior_parity.py."""
+
+    def test_k_posteriors_agree_on_informative_data(self):
+        data, _ = synthetic_subset(
+            jax.random.key(31), 500, 2, 2, [6.0, 9.0],
+            [[1.0, 0.0], [0.5, 0.8]], [[0.8, -0.6], [0.3, 0.5]],
+        )
+
+        def fit(a_prior):
+            cfg = SMKConfig(
+                n_subsets=1, n_samples=1500, burn_in_frac=0.5,
+                priors=PriorConfig(a_prior=a_prior),
+            )
+            model = SpatialProbitGP(cfg, weight=1)
+            st = model.init_state(jax.random.key(5), data)
+            return np.asarray(jax.jit(model.run)(data, st).param_samples)
+
+        ps_n = fit("normal")
+        ps_iw = fit("invwishart")
+        q, p = 2, 2
+        k_cols = slice(q * p, q * p + q * (q + 1) // 2)
+        # distribution-level agreement of the K = A A^T marginals
+        _posteriors_agree(ps_n[:, k_cols], ps_iw[:, k_cols])
+        # and both near the truth K = [[1, .5], [.5, .89]]
+        med_iw = np.median(ps_iw[:, k_cols], 0)
+        assert np.all(np.abs(med_iw - np.array([1.0, 0.5, 0.89])) < 0.75), med_iw
