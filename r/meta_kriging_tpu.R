@@ -41,9 +41,21 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
                                 cov.model = "exponential",
                                 combiner = "wasserstein_mean",
                                 link = c("probit", "logit"),
+                                k.prior = c("invwishart", "normal"),
+                                n.report = NULL,
+                                checkpoint.path = NULL,
                                 backend = c("tpu", "cpu"),
                                 seed = 0L,
                                 python_path = NULL) {
+  # k.prior: prior on the cross-covariance K = A A^T —
+  # "invwishart" is the reference's own K.IW(q, 0.1 I)
+  # (MetaKriging_BinaryResponse.R:64) and the default; "normal" is
+  # the pure-conjugate N(0, a_scale^2)-rows-on-A alternative.
+  # n.report: if set, progress is printed every n.report iterations
+  # (the reference's n.report batch printouts, R:84) — the fit then
+  # runs through the chunked executor. checkpoint.path: if set, the
+  # fit checkpoints each chunk and an interrupted call resumes.
+  k.prior <- match.arg(k.prior)
   # link: the reference workflow is logit (spMvGLM binomial fit,
   # 1/(1+exp(-eta)) at MetaKriging_BinaryResponse.R:160); the TPU
   # default is the exact Albert–Chib probit sampler. Users porting the
@@ -81,9 +93,24 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
     burn_in_frac = burn.in,
     cov_model = cov.model,
     combiner = combiner,
-    link = link
+    link = link,
+    priors = smk$PriorConfig(a_prior = k.prior)
   )
-  res <- smk$fit_meta_kriging(
+  extra <- list()
+  if (!is.null(n.report)) {
+    extra$chunk_iters <- as.integer(n.report)
+    extra$progress <- function(info) {
+      cat(sprintf(
+        "smk [%s] iteration %d/%d  phi acceptance %.3f\n",
+        info$phase, info$iteration, info$n_samples,
+        info$phi_accept_rate
+      ))
+    }
+  }
+  if (!is.null(checkpoint.path)) {
+    extra$checkpoint_path <- checkpoint.path
+  }
+  res <- do.call(smk$fit_meta_kriging, c(list(
     jax$random$key(as.integer(seed)),
     reticulate::np_array(y_arr, dtype = "float32"),
     reticulate::np_array(x_arr, dtype = "float32"),
@@ -92,7 +119,7 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
     reticulate::np_array(xt_arr, dtype = "float32"),
     config = cfg,
     weight = as.integer(weight)
-  )
+  ), extra))
 
   to_r <- function(a) reticulate::py_to_r(reticulate::import("numpy")$asarray(a))
   list(

@@ -1,0 +1,119 @@
+"""K-prior parity experiment (VERDICT r2 #5, open since r1).
+
+The reference puts IW(q, 0.1 I) on the cross-covariance K = A A^T and
+random-walks A (MetaKriging_BinaryResponse.R:64); the TPU build's
+conjugate scheme uses N(0, a_scale^2) rows on A, with the IW prior
+available exactly via an independence-MH correction
+(smk_tpu/models/probit_gp.py step 5, config.priors.a_prior).
+
+This script fits SHARED synthetic q=2 probit data (true
+K = [[1, .5], [.5, .89]]) under both priors at m=800 — large enough
+that the likelihood identifies K — and reports the distribution-level
+agreement of the K marginals: median gaps in posterior-sd units and
+95%-interval overlap. The unit-test version runs at m=500 on CPU
+(tests/test_sampler.py::TestKPriorParity); this is the bigger
+committed-artifact run.
+
+Run on TPU:  python scripts/k_prior_parity.py
+Commit the output (K_PRIOR_PARITY_r03.json).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from smk_tpu.config import PriorConfig, SMKConfig
+from smk_tpu.models.probit_gp import SpatialGPSampler, SubsetData
+from smk_tpu.ops.chol import jittered_cholesky
+from smk_tpu.ops.distance import pairwise_distance
+from smk_tpu.ops.kernels import correlation
+
+M = int(os.environ.get("KP_M", 800))
+N_SAMPLES = int(os.environ.get("KP_SAMPLES", 4000))
+A_TRUE = [[1.0, 0.0], [0.5, 0.8]]
+PHI_TRUE = [6.0, 9.0]
+BETA_TRUE = [[0.8, -0.6], [0.3, 0.5]]
+
+
+def make_data(key, m):
+    q, p = 2, 2
+    kc, ku, ky, kx = jax.random.split(key, 4)
+    coords = jax.random.uniform(kc, (m, 2))
+    dist = pairwise_distance(coords)
+    us = []
+    for j in range(q):
+        l = jittered_cholesky(
+            correlation(dist, PHI_TRUE[j], "exponential"), 1e-4
+        )
+        us.append(l @ jax.random.normal(jax.random.fold_in(ku, j), (m,)))
+    u = jnp.stack(us, -1)
+    w = u @ jnp.asarray(A_TRUE).T
+    x = jnp.concatenate(
+        [jnp.ones((m, q, 1)), jax.random.normal(kx, (m, q, 1))], -1
+    )
+    eta = jnp.einsum("mqp,qp->mq", x, jnp.asarray(BETA_TRUE)) + w
+    y = (
+        jax.random.uniform(ky, eta.shape) < jax.scipy.special.ndtr(eta)
+    ).astype(jnp.float32)
+    return SubsetData(
+        coords=coords.astype(jnp.float32),
+        x=x.astype(jnp.float32),
+        y=y,
+        mask=jnp.ones((m,), jnp.float32),
+        coords_test=coords[:4].astype(jnp.float32) + 0.01,
+        x_test=x[:4].astype(jnp.float32),
+    )
+
+
+def fit(data, a_prior):
+    cfg = SMKConfig(
+        n_subsets=1, n_samples=N_SAMPLES, burn_in_frac=0.5,
+        priors=PriorConfig(a_prior=a_prior),
+    )
+    model = SpatialGPSampler(cfg, weight=1)
+    st = model.init_state(jax.random.key(11), data)
+    t0 = time.time()
+    res = jax.jit(model.run)(data, st)
+    ps = np.asarray(res.param_samples)
+    return ps, time.time() - t0
+
+
+def main():
+    data = make_data(jax.random.key(31), M)
+    ps_n, t_n = fit(data, "normal")
+    ps_iw, t_iw = fit(data, "invwishart")
+    q, p = 2, 2
+    k_cols = slice(q * p, q * p + q * (q + 1) // 2)
+    kn, kiw = ps_n[:, k_cols], ps_iw[:, k_cols]
+    med_n, med_iw = np.median(kn, 0), np.median(kiw, 0)
+    sd = np.maximum(0.5 * (kn.std(0) + kiw.std(0)), 1e-3)
+    lo_n, hi_n = np.quantile(kn, 0.025, 0), np.quantile(kn, 0.975, 0)
+    lo_i, hi_i = np.quantile(kiw, 0.025, 0), np.quantile(kiw, 0.975, 0)
+    overlap = (np.maximum(lo_n, lo_i) <= np.minimum(hi_n, hi_i)).all()
+    k_true = np.array([1.0, 0.5, 0.89])
+    out = {
+        "m": M, "iters": N_SAMPLES,
+        "fit_s": {"normal": round(t_n, 1), "invwishart": round(t_iw, 1)},
+        "K_true": k_true.tolist(),
+        "K_median_normal": np.round(med_n, 3).tolist(),
+        "K_median_invwishart": np.round(med_iw, 3).tolist(),
+        "median_gap_in_sd": np.round(
+            np.abs(med_n - med_iw) / sd, 3
+        ).tolist(),
+        "ci95_overlap_all": bool(overlap),
+        "pass": bool(
+            overlap and (np.abs(med_n - med_iw) / sd < 0.75).all()
+        ),
+    }
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -71,7 +71,7 @@ def profile_variant(name, overrides, data, chunk_iters):
             in_axes=(0, DATA_AXES),
         )
     )(keys, data)
-    jax.block_until_ready(init)
+    device_sync(init.beta)  # block_until_ready is a no-op here
 
     fn = jax.jit(
         jax.vmap(
@@ -118,7 +118,7 @@ def main():
     chunk_iters = int(sys.argv[1]) if len(sys.argv) > 1 else 50
     rng = np.random.default_rng(0)
     data = make_data(rng)
-    jax.block_until_ready(data)
+    device_sync(data.coords)
     print(json.dumps({
         "device": str(jax.devices()[0]),
         "m": M, "K": K, "q": Q, "chunk_iters": chunk_iters,

@@ -14,6 +14,13 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import time
 
 import jax
+
+# this image's sitecustomize force-registers the TPU backend and
+# ignores JAX_PLATFORMS — the smoke drive must NOT touch the chip
+# (single-client tunnel; a concurrent benchmark would be killed), so
+# force CPU through jax.config, which does work
+jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 import numpy as np
 

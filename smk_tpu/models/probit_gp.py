@@ -230,16 +230,17 @@ class SpatialGPSampler:
         # --- 1. link augmentation: Gaussian pseudo-obs (z, omega) -----
         # After this step the model is z ~ N(eta + w, 1/omega)
         # elementwise; both links share every downstream update.
-        eta_fixed = jnp.einsum("mqp,qp->mq", data.x, beta)
-        w = u @ a.T  # (m, q)
-        mu = eta_fixed + w
-        if cfg.link == "probit":
-            zbar = sample_albert_chib_latent(kz, mu, data.y, weight)
-            omega = jnp.full((m, q), float(weight), dtype)
-        else:  # logit: Pólya-Gamma augmentation
-            omega = sample_pg(kz, weight, mu, cfg.pg_n_terms)
-            zbar = (data.y - 0.5 * weight) / omega
-        womega = omega * mask[:, None]  # masked precisions (m, q)
+        with jax.named_scope("augment"):
+            eta_fixed = jnp.einsum("mqp,qp->mq", data.x, beta)
+            w = u @ a.T  # (m, q)
+            mu = eta_fixed + w
+            if cfg.link == "probit":
+                zbar = sample_albert_chib_latent(kz, mu, data.y, weight)
+                omega = jnp.full((m, q), float(weight), dtype)
+            else:  # logit: Pólya-Gamma augmentation
+                omega = sample_pg(kz, weight, mu, cfg.pg_n_terms)
+                zbar = (data.y - 0.5 * weight) / omega
+            womega = omega * mask[:, None]  # masked precisions (m, q)
 
         # --- 2. beta | z, w (conjugate, omega-weighted; near-flat
         # N(0, beta_scale^2) prior — its precision is the only ridge) -
@@ -272,10 +273,12 @@ class SpatialGPSampler:
 
         def phi_mh(_):
             def chol_of(phis):
-                r = masked_correlation(
-                    dist[None], phis[:, None, None], mask, cfg.cov_model
-                )
-                return self._chol_r(r)
+                with jax.named_scope("phi_chol"):
+                    r = masked_correlation(
+                        dist[None], phis[:, None, None], mask,
+                        cfg.cov_model,
+                    )
+                    return self._chol_r(r)
 
             step = jnp.exp(state.phi_log_step)
             t_cur = jnp.log((phi - lo) / (hi - phi))
@@ -375,14 +378,19 @@ class SpatialGPSampler:
                     if cfg.cg_matvec_dtype == "bfloat16"
                     else dtype
                 )
-                mv, diag, apply_r = shifted_correlation_operator(
-                    masked_correlation(dist, phi[j], mask, cfg.cov_model),
-                    jit_eff + d_vec,
-                    mv_dtype,
-                    dtype,
-                )
-                s = cg_solve(mv, rhs_vec, cfg.cg_iters, diag=diag)
-                u = u.at[:, j].set(u_star + apply_r(s) + jit_eff * s)
+                with jax.named_scope("u_cg_solve"):
+                    mv, diag, apply_r = shifted_correlation_operator(
+                        masked_correlation(
+                            dist, phi[j], mask, cfg.cov_model
+                        ),
+                        jit_eff + d_vec,
+                        mv_dtype,
+                        dtype,
+                    )
+                    s = cg_solve(mv, rhs_vec, cfg.cg_iters, diag=diag)
+                    u = u.at[:, j].set(
+                        u_star + apply_r(s) + jit_eff * s
+                    )
             else:
                 # exact dense path: R rebuilt elementwise from the
                 # distance matrix — O(m^2), not the O(m^3) L @ L^T.
@@ -476,6 +484,7 @@ class SpatialGPSampler:
             dist_test[None], phi[:, None, None], cfg.cov_model
         )  # (q, t, t)
 
+        @jax.named_scope("krige")
         def krige(l_j, rc_j, rt_j, u_j, key_j):
             v = tri_solve(l_j, rc_j)  # (m, t)
             alpha = tri_solve(l_j, u_j)  # (m,)

@@ -175,6 +175,14 @@ def fit_subsets_chunked(
             raise ValueError(
                 f"K={k} must be divisible by mesh size {mesh.devices.size}"
             )
+        if chunk_size is not None and chunk_size % mesh.devices.size != 0:
+            # each lax.map step runs `chunk_size` subsets over the
+            # whole mesh — a chunk smaller than the mesh would leave
+            # devices idle (or force GSPMD resharding) every step
+            raise ValueError(
+                f"chunk_size={chunk_size} must be divisible by mesh "
+                f"size {mesh.devices.size} when both are given"
+            )
         shard = NamedSharding(mesh, P(axis))
         repl = NamedSharding(mesh, P())
 

@@ -135,3 +135,53 @@ class TestRFrontendCallSequence:
         names = smk.api.param_names(q, p)
         assert len(names) == d_par
         assert names[0] == "beta[0,0]" and names[-1] == f"phi[{q - 1}]"
+
+
+class TestRFrontendExtendedOptions:
+    def test_k_prior_report_checkpoint_kwargs(self, r_style_inputs, tmp_path):
+        """The r3 front-end additions (r/meta_kriging_tpu.R): k.prior
+        maps to PriorConfig(a_prior=...), n.report to chunk_iters + a
+        progress callable, checkpoint.path to checkpoint_path — this
+        replicates that exact keyword set through the Python API."""
+        import os
+
+        import smk_tpu as smk
+
+        y_list, x_list, xt_list, coords, coords_test = r_style_inputs
+        y_arr = np.column_stack(y_list)
+        x_arr = _r_simplify2array_aperm(x_list)
+        xt_arr = _r_simplify2array_aperm(xt_list)
+
+        cfg = smk.SMKConfig(
+            n_subsets=4,
+            n_samples=40,
+            burn_in_frac=0.5,
+            cov_model="exponential",
+            combiner="wasserstein_mean",
+            link="logit",
+            n_quantiles=20,
+            resample_size=50,
+            priors=smk.PriorConfig(a_prior="invwishart"),
+        )
+        lines = []
+        ckpt = os.path.join(tmp_path, "r_frontend.npz")
+        res = smk.fit_meta_kriging(
+            jax.random.key(0),
+            y_arr.astype(np.float32),
+            x_arr.astype(np.float32),
+            coords.astype(np.float32),
+            coords_test.astype(np.float32),
+            xt_arr.astype(np.float32),
+            config=cfg,
+            weight=1,
+            chunk_iters=10,
+            checkpoint_path=ckpt,
+            progress=lines.append,
+        )
+        assert os.path.exists(ckpt)
+        assert np.isfinite(np.asarray(res.p_quant)).all()
+        # the R callback formats these exact fields (sprintf at
+        # r/meta_kriging_tpu.R) — they must exist with these names
+        assert {"phase", "iteration", "n_samples", "phi_accept_rate"} \
+            <= set(lines[0])
+        assert len(lines) == 4

@@ -304,3 +304,25 @@ class TestUnifiedExecutor:
         )
         assert np.isfinite(np.asarray(res.p_quant)).all()
         assert len(lines) == 4
+
+    def test_mesh_chunk_size_divisibility_enforced(self, tmp_path):
+        from smk_tpu.parallel.executor import make_mesh
+        from smk_tpu.parallel.recovery import fit_subsets_chunked
+
+        model, part, ct, xt, key = self._problem(k=16)
+        mesh = make_mesh(8)
+        with pytest.raises(ValueError, match="divisible by mesh"):
+            fit_subsets_chunked(
+                model, part, ct, xt, key,
+                chunk_iters=30, mesh=mesh, chunk_size=4,
+            )
+        res = fit_subsets_chunked(
+            model, part, ct, xt, key,
+            chunk_iters=30, mesh=mesh, chunk_size=8,
+        )
+        res_ref = fit_subsets_vmap(model, part, ct, xt, key)
+        np.testing.assert_allclose(
+            np.asarray(res_ref.param_samples),
+            np.asarray(res.param_samples),
+            rtol=2e-3, atol=2e-3,
+        )
