@@ -45,8 +45,9 @@ never is.
 Environment knobs: BENCH_LADDER=full|config2 (default full on TPU,
 config2 elsewhere), BENCH_BUDGET_S (default 1450 — the driver kills
 at ~1800 s; leave headroom for interpreter + data-gen + compiles),
-BENCH_SAMPLES / BENCH_CG_ITERS / BENCH_CG_DTYPE / BENCH_PHI_EVERY /
-BENCH_USOLVER / BENCH_CHUNK_ITERS / BENCH_CHOL_BLOCK / BENCH_A_PRIOR
+BENCH_SAMPLES / BENCH_CG_ITERS / BENCH_CG_PRECOND / BENCH_CG_RANK /
+BENCH_CG_DTYPE / BENCH_PHI_EVERY / BENCH_USOLVER / BENCH_CHUNK_ITERS /
+BENCH_CHOL_BLOCK / BENCH_A_PRIOR
 override the solver settings (defaults below are the validated
 scaling-regime configuration).
 
@@ -111,6 +112,13 @@ def op_model(cfg, m, k, q, n_iters, n_kept, t):
     per_comp = k * q
     # CG: one m x m matvec per step; + final apply_r; + u_star L matvec
     cg_flops = per_comp * n_iters * (cfg.cg_iters + 1) * 2 * m * m
+    if cfg.cg_precond == "nystrom":
+        # Nystrom factor build (tri_solve + inner Gram, O(m r^2) each)
+        # per sweep + two (m, r) matvecs per CG step
+        r_pc = min(cfg.cg_precond_rank, m)
+        cg_flops += per_comp * n_iters * (
+            3 * m * r_pc * r_pc + cfg.cg_iters * 4 * m * r_pc
+        )
     ustar_flops = per_comp * n_iters * 2 * m * m
     # phi MH: proposal Cholesky m^3/3 + rebuild + two triangular solves
     chol_flops = per_comp * n_phi * (m**3 / 3 + 4 * m * m)
@@ -124,6 +132,12 @@ def op_model(cfg, m, k, q, n_iters, n_kept, t):
         + mv_bytes * m * m  # r_mv write
         + 4 * m * m  # u_star: chol_r read
     ) + per_comp * n_phi * (4 * 4 * m * m) + per_comp * n_kept * (4 * m * m)
+    if cfg.cg_precond == "nystrom":
+        # Z streamed twice per CG step + ~3 passes for the build
+        r_pc = min(cfg.cg_precond_rank, m)
+        bytes_ += per_comp * n_iters * (
+            (2 * cfg.cg_iters + 3) * 4 * m * r_pc
+        )
     return flops, bytes_, {
         "cg": cg_flops, "chol": chol_flops, "krige": krige_flops,
     }
@@ -154,7 +168,11 @@ def measured_cg_residual(cfg, coords, mask, weight=1):
     fp32 operator, on one real subset's system at bench scale — the
     solver-health diagnostic promised in config.py (the bf16 matvec's
     PD margin is otherwise only tested at m=1024)."""
-    from smk_tpu.ops.cg import cg_solve, shifted_correlation_operator
+    from smk_tpu.ops.cg import (
+        cg_solve,
+        nystrom_preconditioner,
+        shifted_correlation_operator,
+    )
     from smk_tpu.ops.distance import pairwise_distance
     from smk_tpu.models.probit_gp import masked_correlation
 
@@ -179,7 +197,14 @@ def measured_cg_residual(cfg, coords, mask, weight=1):
                 jax.random.key(99), (coords.shape[0],), dtype
             )
             if cfg.u_solver == "cg":
-                x_sol = cg_solve(mv, rhs, cfg.cg_iters, diag=diag)
+                if cfg.cg_precond == "nystrom":
+                    rank = min(cfg.cg_precond_rank, coords.shape[0])
+                    pre = nystrom_preconditioner(
+                        r[:, :rank], jit_eff + d_vec
+                    )
+                    x_sol = cg_solve(mv, rhs, cfg.cg_iters, precond=pre)
+                else:
+                    x_sol = cg_solve(mv, rhs, cfg.cg_iters, diag=diag)
             else:
                 from smk_tpu.ops.chol import chol_solve, jittered_cholesky
 
@@ -225,13 +250,22 @@ def run_rung(name, *, n, k, cov_model, n_samples, q=1, p=2, n_test=64,
     y, x, coords, coords_test, x_test = (
         y[:n], x[:n], coords[:n], coords[n:], x[n:],
     )
+    precond = env.get("BENCH_CG_PRECOND", "nystrom")
     cfg = SMKConfig(
         n_subsets=k,
         n_samples=n_samples,
         cov_model=cov_model,
         link=link,
         u_solver=env.get("BENCH_USOLVER", "cg"),
-        cg_iters=int(env.get("BENCH_CG_ITERS", 32)),
+        # Nystrom-preconditioned CG reaches the bf16 matvec's residual
+        # floor in ~8 steps vs Jacobi's 32 (ops/cg.py) — 4x fewer
+        # m x m HBM streams in the bandwidth-bound u-update; measured
+        # 70.8 vs 90.1 ms/iter at the config-5 slice (PROFILE_SLICE)
+        cg_iters=int(
+            env.get("BENCH_CG_ITERS", 8 if precond == "nystrom" else 32)
+        ),
+        cg_precond=precond,
+        cg_precond_rank=int(env.get("BENCH_CG_RANK", 256)),
         cg_matvec_dtype=env.get("BENCH_CG_DTYPE", "bfloat16"),
         phi_update_every=int(env.get("BENCH_PHI_EVERY", 4)),
         chol_block_size=int(env.get("BENCH_CHOL_BLOCK", 0)),

@@ -46,7 +46,8 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
                                 checkpoint.path = NULL,
                                 backend = c("tpu", "cpu"),
                                 seed = 0L,
-                                python_path = NULL) {
+                                python_path = NULL,
+                                config.overrides = list()) {
   # k.prior: prior on the cross-covariance K = A A^T —
   # "invwishart" is the reference's own K.IW(q, 0.1 I)
   # (MetaKriging_BinaryResponse.R:64) and the default; "normal" is
@@ -87,7 +88,12 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
   }
   smk <- reticulate::import("smk_tpu")
 
-  cfg <- smk$SMKConfig(
+  # config.overrides: named list merged into the SMKConfig call —
+  # exposes every typed field (solver knobs like u_solver / cg_iters /
+  # cg_precond, jitter, matmul_precision, ...) without enumerating
+  # them here; integer-valued fields must be passed as integers
+  # (e.g. list(u_solver = "cg", cg_iters = 8L, cg_precond = "nystrom"))
+  cfg_args <- utils::modifyList(list(
     n_subsets = as.integer(n.core),
     n_samples = as.integer(n.samples),
     burn_in_frac = burn.in,
@@ -95,7 +101,8 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
     combiner = combiner,
     link = link,
     priors = smk$PriorConfig(a_prior = k.prior)
-  )
+  ), config.overrides)
+  cfg <- do.call(smk$SMKConfig, cfg_args)
   extra <- list()
   if (!is.null(n.report)) {
     extra$chunk_iters <- as.integer(n.report)

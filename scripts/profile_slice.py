@@ -124,31 +124,54 @@ def main():
         "m": M, "K": K, "q": Q, "chunk_iters": chunk_iters,
     }), flush=True)
 
-    variants = [
-        ("cg32_bf16_phi2", dict(u_solver="cg", cg_iters=32,
-                               cg_matvec_dtype="bfloat16",
+    # Round-3 knob ladder (the Jacobi-CG ladder that picked the first
+    # r3 default is archived in PROFILE_SLICE_r03.jsonl): the Nystrom
+    # PCG candidates vs that default. PROF_VARIANTS=jacobi re-runs the
+    # original ladder.
+    if os.environ.get("PROF_VARIANTS") == "jacobi":
+        variants = [
+            ("cg32_bf16_phi2", dict(u_solver="cg", cg_iters=32,
+                                   cg_matvec_dtype="bfloat16",
+                                   phi_update_every=2)),
+            ("cg32_bf16_nophi", dict(u_solver="cg", cg_iters=32,
+                            cg_matvec_dtype="bfloat16",
+                            phi_update_every=10_000)),
+            ("cg32_bf16_phi1", dict(u_solver="cg", cg_iters=32,
+                                 cg_matvec_dtype="bfloat16",
+                                 phi_update_every=1)),
+            ("cg16_bf16_phi2", dict(u_solver="cg", cg_iters=16,
+                          cg_matvec_dtype="bfloat16",
+                          phi_update_every=2)),
+            ("cg32_fp32_phi2", dict(u_solver="cg", cg_iters=32,
+                               cg_matvec_dtype="float32",
                                phi_update_every=2)),
-        # phi never updates inside the chunk -> pure CG + augmentation
-        ("cg32_bf16_nophi", dict(u_solver="cg", cg_iters=32,
-                        cg_matvec_dtype="bfloat16",
-                        phi_update_every=10_000)),
-        # phi every sweep -> isolates the Cholesky increment
-        ("cg32_bf16_phi1", dict(u_solver="cg", cg_iters=32,
-                             cg_matvec_dtype="bfloat16",
-                             phi_update_every=1)),
-        # CG depth halved
-        ("cg16_bf16_phi2", dict(u_solver="cg", cg_iters=16,
-                      cg_matvec_dtype="bfloat16",
-                      phi_update_every=2)),
-        # fp32 matvec (bandwidth doubled) for the bf16 win measurement
-        ("cg32_fp32_phi2", dict(u_solver="cg", cg_iters=32,
-                           cg_matvec_dtype="float32",
-                           phi_update_every=2)),
-        # bench r3 default: the measured mixing/wall-clock sweet spot
-        ("cg32_bf16_phi4_BENCH_DEFAULT_r3", dict(u_solver="cg", cg_iters=32,
-                             cg_matvec_dtype="bfloat16",
-                             phi_update_every=4)),
-    ]
+            ("cg32_bf16_phi4_BENCH_DEFAULT_r3", dict(
+                                 u_solver="cg", cg_iters=32,
+                                 cg_matvec_dtype="bfloat16",
+                                 phi_update_every=4)),
+        ]
+    else:
+        nys = dict(u_solver="cg", cg_precond="nystrom",
+                   cg_precond_rank=256, cg_matvec_dtype="bfloat16")
+        variants = [
+            # control: the first r3 default (Jacobi CG-32)
+            ("cg32_bf16_phi4_jacobi", dict(u_solver="cg", cg_iters=32,
+                                 cg_matvec_dtype="bfloat16",
+                                 phi_update_every=4)),
+            ("nys10_bf16_phi4", dict(**nys, cg_iters=10,
+                                     phi_update_every=4)),
+            ("nys8_bf16_phi4", dict(**nys, cg_iters=8,
+                                    phi_update_every=4)),
+            # the saved CG time may buy phi mixing back
+            ("nys10_bf16_phi2", dict(**nys, cg_iters=10,
+                                     phi_update_every=2)),
+            # fp32 matvec + Nystrom: 1e-3-level residuals at 2x stream
+            # width — the accuracy-first candidate
+            ("nys10_fp32_phi4", dict(u_solver="cg", cg_precond="nystrom",
+                                     cg_precond_rank=256, cg_iters=10,
+                                     cg_matvec_dtype="float32",
+                                     phi_update_every=4)),
+        ]
     for name, ov in variants:
         try:
             profile_variant(name, ov, data, chunk_iters)

@@ -10,7 +10,8 @@ cannot change the stationary distribution — this measures that the
 SLOWER MIXING doesn't bias the finite-run estimates the bench reports.
 
 Runs K subsets of shared synthetic probit data under the full bench
-solver configuration (CG-32 bf16, IW K-prior) with phi updated every
+solver configuration (Nystrom-256 PCG CG-8 bf16, IW K-prior — the r3
+defaults; PHI_CG_* env overrides) with phi updated every
 sweep vs every 4th sweep, and compares per-subset posterior medians of
 (beta, K, phi) in units of posterior sd.
 
@@ -48,7 +49,18 @@ def fit(data, phi_update_every, n_samples):
         n_samples=n_samples,
         cov_model="exponential",
         u_solver="cg",
-        cg_iters=32,
+        # the bench's r3 solver defaults (bench.py run_rung) — the
+        # iteration default is COUPLED to the preconditioner exactly
+        # as in bench.py (Jacobi needs 32 steps where Nystrom needs 8)
+        cg_iters=int(
+            os.environ.get(
+                "PHI_CG_ITERS",
+                8 if os.environ.get("PHI_CG_PRECOND", "nystrom")
+                == "nystrom" else 32,
+            )
+        ),
+        cg_precond=os.environ.get("PHI_CG_PRECOND", "nystrom"),
+        cg_precond_rank=256,
         cg_matvec_dtype="bfloat16",
         phi_update_every=phi_update_every,
         priors=PriorConfig(a_prior="invwishart"),

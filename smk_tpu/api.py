@@ -138,6 +138,7 @@ def fit_meta_kriging(
     checkpoint_path: Optional[str] = None,
     checkpoint_every: int = 500,
     progress=None,
+    nan_guard: bool = False,
 ) -> MetaKrigingResult:
     """Full spatial-meta-kriging pipeline.
 
@@ -160,6 +161,11 @@ def fit_meta_kriging(
       an interrupted call resumes bit-exactly.
     - ``progress``: per-chunk callback(dict) with iteration count and
       running phi acceptance (reference n.report parity, R:84).
+    - ``nan_guard``: per-chunk in-chain NaN/inf check on the carried
+      state; raises parallel.recovery.SubsetNaNError naming the failed
+      subsets before the checkpoint is overwritten (implies chunked
+      execution). Post-hoc detection (find_failed_subsets /
+      rerun_subsets) remains for the unchunked paths.
     """
     cfg = config or SMKConfig()
     times = PhaseTimes()
@@ -193,6 +199,7 @@ def fit_meta_kriging(
             checkpoint_path is not None
             or chunk_iters is not None
             or progress is not None
+            or nan_guard
         ):
             from smk_tpu.parallel.recovery import fit_subsets_chunked
 
@@ -208,6 +215,7 @@ def fit_meta_kriging(
                 mesh=run_mesh,
                 chunk_size=chunk_size,
                 progress=progress,
+                nan_guard=nan_guard,
             )
         elif sharded or mesh is not None:
             results = fit_subsets_sharded(

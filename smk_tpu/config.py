@@ -134,6 +134,22 @@ class SMKConfig:
     cg_iters: int = 64
     cg_matvec_dtype: str = "float32"
 
+    # CG preconditioner. "jacobi": the operator diagonal — free, and
+    # required to absorb the padded-row pseudo-variances. "nystrom":
+    # rank-`cg_precond_rank` Nystrom approximation of R from the
+    # subset's first r (randomly permuted) rows, applied by Woodbury —
+    # O(m r) per CG step on top of the O(m^2) matvec. The correlation
+    # spectrum decays like k^-2 (Matern-1/2, 2D), so rank 256 leaves a
+    # residual spectrum far below the noise shift and the solve
+    # converges in ~8-10 steps instead of ~32 (measured at m=3906
+    # across the phi prior range; ops/cg.py:nystrom_preconditioner).
+    # With the bfloat16 matvec both preconditioners bottom out at the
+    # bf16 matrix-rounding floor (~2e-2 relative residual) — Nystrom
+    # just gets there in 4x fewer m x m HBM streams, which is the
+    # whole point at bandwidth-bound bench scale.
+    cg_precond: str = "jacobi"
+    cg_precond_rank: int = 256
+
     # Blocked-GEMM Cholesky for the phi-MH proposal factorization (the
     # one remaining O(m^3) kernel): 0 = XLA's native cholesky; > 0 =
     # ops/chol.py blocked_cholesky with this block size (the same
@@ -204,6 +220,10 @@ class SMKConfig:
             raise ValueError(
                 "cg_matvec_dtype must be 'float32' or 'bfloat16'"
             )
+        if self.cg_precond not in ("jacobi", "nystrom"):
+            raise ValueError("cg_precond must be 'jacobi' or 'nystrom'")
+        if self.cg_precond_rank < 1:
+            raise ValueError("cg_precond_rank must be >= 1")
         if self.jitter <= 0 or self.jitter_per_m < 0:
             raise ValueError(
                 "jitter must be > 0 and jitter_per_m >= 0"

@@ -68,7 +68,11 @@ from smk_tpu.ops.chol import (
     jittered_cholesky,
     tri_solve,
 )
-from smk_tpu.ops.cg import cg_solve, shifted_correlation_operator
+from smk_tpu.ops.cg import (
+    cg_solve,
+    nystrom_preconditioner,
+    shifted_correlation_operator,
+)
 from smk_tpu.ops.distance import cross_distance, pairwise_distance
 from smk_tpu.ops.kernels import correlation
 from smk_tpu.ops.polya_gamma import sample_pg
@@ -379,15 +383,31 @@ class SpatialGPSampler:
                     else dtype
                 )
                 with jax.named_scope("u_cg_solve"):
-                    mv, diag, apply_r = shifted_correlation_operator(
-                        masked_correlation(
-                            dist, phi[j], mask, cfg.cov_model
-                        ),
-                        jit_eff + d_vec,
-                        mv_dtype,
-                        dtype,
+                    r_full = masked_correlation(
+                        dist, phi[j], mask, cfg.cov_model
                     )
-                    s = cg_solve(mv, rhs_vec, cfg.cg_iters, diag=diag)
+                    mv, diag, apply_r = shifted_correlation_operator(
+                        r_full, jit_eff + d_vec, mv_dtype, dtype
+                    )
+                    if cfg.cg_precond == "nystrom":
+                        # Landmarks = the subset's first r rows (a
+                        # uniform spatial sample after the partition
+                        # permutation). Rebuilt per sweep: the Nystrom
+                        # factor is O(m r^2) of GEMM work — trivial
+                        # next to even one m x m matvec stream — and
+                        # keeping it out of the carried state leaves
+                        # the checkpoint format untouched.
+                        rank = min(cfg.cg_precond_rank, m)
+                        pre = nystrom_preconditioner(
+                            r_full[:, :rank], jit_eff + d_vec
+                        )
+                        s = cg_solve(
+                            mv, rhs_vec, cfg.cg_iters, precond=pre
+                        )
+                    else:
+                        s = cg_solve(
+                            mv, rhs_vec, cfg.cg_iters, diag=diag
+                        )
                     u = u.at[:, j].set(
                         u_star + apply_r(s) + jit_eff * s
                     )

@@ -23,6 +23,8 @@ from typing import Callable
 import jax.numpy as jnp
 from jax import lax
 
+from smk_tpu.ops.chol import chol_solve, jittered_cholesky, tri_solve
+
 
 def shifted_correlation_operator(r, shift, matvec_dtype, acc_dtype):
     """The sampler's u-update operator x -> R x + shift * x, with R
@@ -53,11 +55,74 @@ def shifted_correlation_operator(r, shift, matvec_dtype, acc_dtype):
     return matvec, 1.0 + shift, apply_r
 
 
+def nystrom_preconditioner(
+    k_mr: jnp.ndarray,
+    shift: jnp.ndarray,
+    rr_jitter: float = 1e-4,
+) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    """Rank-r Nystrom preconditioner for A = R + diag(shift).
+
+    k_mr: (m, r) — the first r columns of the (masked) correlation R;
+    its top (r, r) block is the landmark Gram matrix. Callers pass
+    ``R[:, :r]``: the landmarks are the subset's first r rows, which
+    the partitioner has already randomly permuted (partition.py), so
+    they are a uniform spatial sample (pad rows, if any, sit at the
+    subset tail and their masked columns are standard-basis vectors —
+    harmless rank-one identity terms).
+    shift: scalar or (m,) positive diagonal (jitter + noise variances).
+
+    Returns v -> M^{-1} v for M = Z Z^T + diag(shift), where
+    Z = K_mr chol(K_rr)^{-T} is the Nystrom factor (Z Z^T is the
+    Nystrom approximation of R from these landmarks). Woodbury gives
+      M^{-1} = S - S Z (I_r + Z^T S Z)^{-1} Z^T S,  S = diag(shift)^{-1},
+    so one application costs two (m, r) matvecs + an (r, r) Cholesky
+    solve — O(m r), negligible next to the O(m^2) CG matvec.
+
+    Why this works: the spatial correlation's eigenvalues decay
+    polynomially (Matern-1/2 in 2D: lambda_k ~ k^-2), so a rank-256
+    Nystrom capture leaves a residual spectrum of order
+    lambda_r ~ lambda_1/r^2 << shift — the preconditioned operator's
+    condition number collapses to ~1 + lambda_r/shift. Measured at
+    m=3906, phi in the Unif(4, 12) prior range: 8-10 preconditioned
+    steps match or beat 32 Jacobi steps (fp32: 1e-4..1e-3 relative
+    residual vs Jacobi-32's 3e-3..2e-2; bfloat16 matvec: both hit the
+    bf16 matrix-rounding floor ~2e-2, the Nystrom path in 4x fewer
+    m x m streams). See tests/test_ops.py::TestCGModerateM.
+
+    The returned closure accepts 1-D (m,) vectors only (the sampler's
+    per-component solves); cg_solve's batched-b form needs a batched
+    preconditioner the caller would build with vmap.
+    """
+    m, r = k_mr.shape
+    eye_r = jnp.eye(r, dtype=k_mr.dtype)
+    l_rr = jittered_cholesky(k_mr[:r, :], rr_jitter)
+    # Explicit small inverses instead of per-application triangular
+    # solves: TPU trisolves are latency-bound (sequential panel
+    # recurrence), and at r <= 256 on SPD, jitter-regularized blocks
+    # the explicit inverse is both tiny and safe — the factor build
+    # and every preconditioner application become pure (m, r) GEMMs
+    # that ride the MXU (measured: the trisolve form cost ~2x the
+    # matvec savings it enabled at m=3906).
+    inv_l = tri_solve(l_rr, eye_r)  # (r, r) = L_rr^{-1}
+    z = k_mr @ inv_l.T  # (m, r) Nystrom factor
+    s = 1.0 / (jnp.zeros((m,), k_mr.dtype) + shift)
+    w = z * s[:, None]
+    # I_r + Z^T S Z is SPD by construction (identity + PSD Gram)
+    c = jittered_cholesky(eye_r + z.T @ w, 0.0)
+    e = chol_solve(c, eye_r)  # (r, r) inner inverse
+
+    def precond(v):
+        return s * v - w @ (e @ (w.T @ v))
+
+    return precond
+
+
 def cg_solve(
     matvec: Callable[[jnp.ndarray], jnp.ndarray],
     b: jnp.ndarray,
     n_iters: int = 64,
     diag: jnp.ndarray | None = None,
+    precond: Callable[[jnp.ndarray], jnp.ndarray] | None = None,
 ) -> jnp.ndarray:
     """Solve A x = b with `n_iters` (P)CG steps (A SPD via `matvec`).
 
@@ -67,12 +132,16 @@ def cg_solve(
     which would otherwise wreck the condition number. Zero initial
     guess, static iteration count; eps-guarded divisions keep the
     recurrence finite after convergence stalls.
+    precond: optional SPD preconditioner application r -> M^{-1} r
+    (e.g. nystrom_preconditioner); takes precedence over `diag` and
+    must accept the same shape as b.
     """
     eps = jnp.asarray(1e-20, b.dtype)
-    inv_diag = None if diag is None else 1.0 / jnp.maximum(diag, eps)
+    if precond is None:
+        inv_diag = None if diag is None else 1.0 / jnp.maximum(diag, eps)
 
-    def precond(r):
-        return r if inv_diag is None else inv_diag * r
+        def precond(r):
+            return r if inv_diag is None else inv_diag * r
 
     def body(carry, _):
         x, r, p, rz = carry

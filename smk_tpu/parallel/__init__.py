@@ -14,6 +14,7 @@ from smk_tpu.parallel.combine import (
     combine_quantile_grids,
 )
 from smk_tpu.parallel.recovery import (
+    SubsetNaNError,
     fit_subsets_checkpointed,
     fit_subsets_chunked,
     find_failed_subsets,
@@ -29,6 +30,7 @@ __all__ = [
     "fit_subsets_chunked",
     "find_failed_subsets",
     "rerun_subsets",
+    "SubsetNaNError",
     "make_mesh",
     "wasserstein_barycenter",
     "weiszfeld_median",

@@ -47,6 +47,38 @@ from smk_tpu.utils.checkpoint import load_pytree, save_pytree
 CKPT_VERSION = 3
 
 
+class SubsetNaNError(RuntimeError):
+    """In-chain NaN/inf detected by the chunked executor's nan_guard.
+
+    Carries which subsets went non-finite and at which global
+    iteration. The guard raises BEFORE the chunk's checkpoint save, so
+    ``checkpoint_path`` still holds the last finite state — resume
+    from it, or ``rerun_subsets`` the named shards from scratch.
+    """
+
+    def __init__(self, subset_ids, iteration):
+        self.subset_ids = list(int(i) for i in subset_ids)
+        self.iteration = int(iteration)
+        super().__init__(
+            f"sampler state non-finite in subsets {self.subset_ids} "
+            f"at iteration {self.iteration}; the last checkpoint (if "
+            "any) precedes the failure — resume from it or re-run the "
+            "failed shards (rerun_subsets)"
+        )
+
+
+@jax.jit
+def _finite_subsets(state) -> jnp.ndarray:
+    """(K,) bool: every small carried leaf finite per subset. chol_r
+    is deliberately excluded (it is the one O(m^2) leaf, and any
+    non-finite factor propagates into u within one sweep)."""
+    oks = [
+        jnp.isfinite(leaf).reshape(leaf.shape[0], -1).all(axis=1)
+        for leaf in (state.beta, state.u, state.a, state.phi)
+    ]
+    return jnp.stack(oks).all(axis=0)
+
+
 def _key_bytes(key) -> bytes:
     """Raw bytes of a PRNG key, accepting both typed keys and legacy
     raw uint32 key arrays (jax.random.split handles both; the
@@ -136,6 +168,7 @@ def fit_subsets_chunked(
     chunk_size: Optional[int] = None,
     progress=None,
     stop_after_chunks: Optional[int] = None,
+    nan_guard: bool = False,
 ) -> Optional[SubsetResult]:
     """Unified chunked K-subset executor: the whole MCMC (burn-in AND
     sampling) runs as a host loop of ``chunk_iters``-long compiled
@@ -155,6 +188,13 @@ def fit_subsets_chunked(
       parity hook (the reference prints acceptance every 10 batches,
       MetaKriging_BinaryResponse.R:84); receives phase, iteration,
       n_samples and the running phi acceptance rate.
+
+    - ``nan_guard``: after every chunk, check the carried state's
+      small leaves for NaN/inf per subset and raise
+      :class:`SubsetNaNError` (naming the shards, BEFORE the save —
+      the last checkpoint stays finite/resumable) instead of silently
+      burning the rest of a multi-hour run. One tiny on-device reduce
+      + host fetch per chunk; the post-hoc net is find_failed_subsets.
 
     ``stop_after_chunks`` ends the run early after that many chunks
     (burn or sampling), returning None with the checkpoint on disk —
@@ -328,12 +368,20 @@ def fit_subsets_chunked(
             ),
         })
 
+    def guard():
+        if not nan_guard:
+            return
+        ok = np.asarray(_finite_subsets(state))
+        if not ok.all():
+            raise SubsetNaNError(np.where(~ok)[0], it)
+
     chunks_done = 0
     n_burn = cfg.n_burn_in
     while it < n_burn:
         n = min(chunk_iters, n_burn - it)
         state = chunk_fn("burn", n)(data, state, jnp.asarray(it))
         it += n
+        guard()
         # report before the boundary reset so the last burn line
         # carries the full burn-in acceptance, not 0.0
         report("burn", 0)
@@ -359,6 +407,7 @@ def fit_subsets_chunked(
         param_draws = jnp.concatenate([param_draws, pd], axis=1)
         w_draws = jnp.concatenate([w_draws, wd], axis=1)
         it += n
+        guard()
         report("sample", n_burn)
         save()
         chunks_done += 1
@@ -387,6 +436,7 @@ def fit_subsets_checkpointed(
     mesh=None,
     chunk_size: Optional[int] = None,
     progress=None,
+    nan_guard: bool = False,
 ) -> Optional[SubsetResult]:
     """K-subset fan-out with periodic checkpointing and resume — the
     checkpoint-requiring entry point over ``fit_subsets_chunked`` (see
@@ -399,6 +449,7 @@ def fit_subsets_checkpointed(
         chunk_size=chunk_size,
         progress=progress,
         stop_after_chunks=stop_after_chunks,
+        nan_guard=nan_guard,
     )
 
 

@@ -57,3 +57,23 @@ def phase_timer(times: PhaseTimes, name: str) -> Iterator[None]:
         yield
     finally:
         times.record(name, time.perf_counter() - start)
+
+
+@contextlib.contextmanager
+def debug_nans(enable: bool = True) -> Iterator[None]:
+    """Scope jax_debug_nans around a block (SURVEY.md §5.2).
+
+    Under this context every jit-compiled program is re-run op-by-op
+    when its output contains a NaN, and the producing primitive raises
+    with a traceback — the right tool for *localizing* a NaN the
+    chunked executor's nan_guard (parallel/recovery.py) or
+    find_failed_subsets flagged. Debugging-only: it forces
+    re-execution and defeats donation/fusion, so it must never wrap a
+    production fit.
+    """
+    old = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", enable)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", old)

@@ -185,3 +185,31 @@ class TestRFrontendExtendedOptions:
         assert {"phase", "iteration", "n_samples", "phi_accept_rate"} \
             <= set(lines[0])
         assert len(lines) == 4
+
+
+class TestConfigOverrides:
+    def test_overrides_merge_like_modifyList(self):
+        """r/meta_kriging_tpu.R builds SMKConfig via
+        utils::modifyList(base, config.overrides) + do.call — i.e. a
+        name-wise merge where overrides win. The merged keyword set
+        must be accepted by SMKConfig, including the solver knobs the
+        overrides exist to expose."""
+        import smk_tpu as smk
+
+        base = dict(
+            n_subsets=4,
+            n_samples=60,
+            burn_in_frac=0.5,
+            cov_model="exponential",
+            combiner="wasserstein_mean",
+            link="probit",
+            priors=smk.PriorConfig(a_prior="invwishart"),
+        )
+        overrides = dict(
+            u_solver="cg", cg_iters=8, cg_precond="nystrom",
+            cg_precond_rank=64, cov_model="matern32",
+        )
+        cfg = smk.SMKConfig(**{**base, **overrides})
+        assert cfg.cg_precond == "nystrom"
+        assert cfg.cov_model == "matern32"  # override wins
+        assert cfg.n_subsets == 4  # base survives

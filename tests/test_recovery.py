@@ -326,3 +326,93 @@ class TestUnifiedExecutor:
             np.asarray(res.param_samples),
             rtol=2e-3, atol=2e-3,
         )
+
+
+class TestNaNGuard:
+    """In-chain NaN detection (SURVEY.md §5.2): the chunked executor's
+    nan_guard fails fast, names the poisoned shards, and never
+    overwrites a good checkpoint with non-finite state."""
+
+    def _poisoned(self, problem, bad_subset=2):
+        # Poison coords, not y: a NaN response would just steer the
+        # truncation-side comparisons in the probit augmentation (NaN
+        # predicates pick a branch and the draw stays finite), while a
+        # NaN coordinate makes the correlation — and with it chol_r
+        # and the first u draw — non-finite immediately.
+        model, part, ct, xt, key = problem
+        c_bad = np.asarray(part.coords).copy()
+        c_bad[bad_subset, 0, 0] = np.nan
+        return (
+            model, part._replace(coords=jnp.asarray(c_bad)), ct, xt, key,
+        )
+
+    def test_guard_names_poisoned_subset(self, problem):
+        from smk_tpu.parallel.recovery import (
+            SubsetNaNError,
+            fit_subsets_chunked,
+        )
+
+        model, part_bad, ct, xt, key = self._poisoned(problem)
+        with pytest.raises(SubsetNaNError) as ei:
+            fit_subsets_chunked(
+                model, part_bad, ct, xt, key,
+                chunk_iters=10, nan_guard=True,
+            )
+        assert ei.value.subset_ids == [2]
+        # NaN data poisons the very first chunk
+        assert ei.value.iteration == 10
+
+    def test_guard_raises_before_first_save(self, problem, tmp_path):
+        """The guard runs before save(): a run that is non-finite from
+        chunk one must leave NO checkpoint (and, by the same ordering,
+        a mid-run NaN leaves the previous finite checkpoint intact)."""
+        from smk_tpu.parallel.recovery import (
+            SubsetNaNError,
+            fit_subsets_chunked,
+        )
+
+        model, part_bad, ct, xt, key = self._poisoned(problem)
+        path = os.path.join(tmp_path, "guarded.npz")
+        with pytest.raises(SubsetNaNError):
+            fit_subsets_chunked(
+                model, part_bad, ct, xt, key,
+                chunk_iters=10, checkpoint_path=path, nan_guard=True,
+            )
+        assert not os.path.exists(path)
+
+    def test_clean_run_unchanged_by_guard(self, problem):
+        from smk_tpu.parallel.recovery import fit_subsets_chunked
+
+        model, part, ct, xt, key = problem
+        res_off = fit_subsets_chunked(
+            model, part, ct, xt, key, chunk_iters=20,
+        )
+        res_on = fit_subsets_chunked(
+            model, part, ct, xt, key, chunk_iters=20, nan_guard=True,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res_off.param_samples),
+            np.asarray(res_on.param_samples),
+        )
+
+    def test_api_nan_guard_passthrough(self, problem):
+        """nan_guard alone routes fit_meta_kriging through the chunked
+        executor and surfaces the error."""
+        from smk_tpu.api import fit_meta_kriging
+        from smk_tpu.config import SMKConfig
+        from smk_tpu.parallel.recovery import SubsetNaNError
+
+        rng = np.random.default_rng(3)
+        n, q, p, t = 48, 1, 2, 4
+        coords = jnp.asarray(rng.uniform(size=(n, 2)), jnp.float32)
+        x = jnp.asarray(rng.normal(size=(n, q, p)), jnp.float32)
+        y = np.asarray(rng.integers(0, 2, size=(n, q)), np.float32)
+        y[5, 0] = np.nan
+        ct = jnp.asarray(rng.uniform(size=(t, 2)), jnp.float32)
+        xt = jnp.asarray(rng.normal(size=(t, q, p)), jnp.float32)
+        cfg = SMKConfig(n_subsets=4, n_samples=40, burn_in_frac=0.5)
+        with pytest.raises(SubsetNaNError):
+            fit_meta_kriging(
+                jax.random.key(0), jnp.asarray(y), x, coords, ct, xt,
+                config=cfg, nan_guard=True, chunk_iters=10,
+            )

@@ -82,3 +82,33 @@ class TestCheckpoint:
         save_pytree(path, {"a": jnp.ones(3)})
         with pytest.raises(ValueError):
             load_pytree(path, {"a": jnp.ones(3), "b": jnp.ones(2)})
+
+
+class TestDebugNaNs:
+    def test_scopes_flag_and_localizes_nan(self):
+        import pytest
+
+        from smk_tpu.utils.tracing import debug_nans
+
+        before = jax.config.jax_debug_nans
+
+        @jax.jit
+        def bad(x):
+            return jnp.log(x) * 0.0 + jnp.sqrt(x - 2.0)
+
+        with debug_nans():
+            assert jax.config.jax_debug_nans
+            with pytest.raises(FloatingPointError):
+                _ = float(bad(jnp.asarray(1.0)))
+        assert jax.config.jax_debug_nans == before
+
+    def test_restores_flag_on_error(self):
+        from smk_tpu.utils.tracing import debug_nans
+
+        before = jax.config.jax_debug_nans
+        try:
+            with debug_nans():
+                raise RuntimeError("boom")
+        except RuntimeError:
+            pass
+        assert jax.config.jax_debug_nans == before
