@@ -34,7 +34,7 @@ import numpy as np
 from bench import make_binary_field
 from smk_tpu.config import PriorConfig, SMKConfig
 from smk_tpu.models.probit_gp import SpatialGPSampler
-from smk_tpu.parallel.executor import DATA_AXES, stacked_subset_data
+from smk_tpu.parallel.recovery import fit_subsets_chunked
 from smk_tpu.parallel.partition import random_partition
 from smk_tpu.utils.tracing import device_sync
 
@@ -43,7 +43,12 @@ K = int(os.environ.get("PHI_K", 8))
 N_SAMPLES = int(os.environ.get("PHI_SAMPLES", 3000))
 
 
-def fit(data, phi_update_every, n_samples):
+def fit(part, ct, xt, phi_update_every, n_samples):
+    # Chunked host-loop dispatch through the PRODUCTION executor
+    # (parallel/recovery.py): the single whole-run dispatch this
+    # script originally used crashed the tunnel's TPU worker on the
+    # 12k-iteration arm — the same fragility that drove bench.py and
+    # the public API to chunked execution.
     cfg = SMKConfig(
         n_subsets=K,
         n_samples=n_samples,
@@ -66,16 +71,12 @@ def fit(data, phi_update_every, n_samples):
         priors=PriorConfig(a_prior="invwishart"),
     )
     model = SpatialGPSampler(cfg, weight=1)
-    keys = jax.random.split(jax.random.key(7), K)
-    init = jax.jit(
-        jax.vmap(
-            lambda kk, d: model.init_state(kk, d, None),
-            in_axes=(0, DATA_AXES),
-        )
-    )(keys, data)
-    run = jax.jit(jax.vmap(model.run, in_axes=(DATA_AXES, 0)))
     t0 = time.time()
-    res = run(data, init)
+    res = fit_subsets_chunked(
+        model, part, ct, xt, jax.random.key(7),
+        chunk_iters=int(os.environ.get("PHI_CHUNK_ITERS", 500)),
+        nan_guard=True,
+    )
     ps = np.asarray(res.param_samples)  # forces completion
     return ps, np.asarray(res.phi_accept_rate), time.time() - t0
 
@@ -87,8 +88,7 @@ def main():
         np.random.default_rng(0).uniform(size=(16, 2)), jnp.float32
     )
     xt = jnp.ones((16, 1, 2), jnp.float32)
-    data = stacked_subset_data(part, ct, xt)
-    device_sync(data.coords)
+    device_sync(part.coords)
 
     from smk_tpu.utils.diagnostics import effective_sample_size
 
@@ -97,9 +97,9 @@ def main():
     #   phi4@N           — equal wall-clock: shows the phi-ESS COST
     #   phi4@4N          — equal phi-UPDATE count: shows the schedule
     #                      does not shift the target (validity)
-    ps1, acc1, t1 = fit(data, 1, N_SAMPLES)
-    ps4, acc4, t4 = fit(data, 4, N_SAMPLES)
-    ps4l, acc4l, t4l = fit(data, 4, 4 * N_SAMPLES)
+    ps1, acc1, t1 = fit(part, ct, xt, 1, N_SAMPLES)
+    ps4, acc4, t4 = fit(part, ct, xt, 4, N_SAMPLES)
+    ps4l, acc4l, t4l = fit(part, ct, xt, 4, 4 * N_SAMPLES)
 
     names = ["beta0", "beta1", "K00", "phi"]
 
@@ -108,19 +108,30 @@ def main():
         sd = np.maximum(0.5 * (psa.std(1) + psb.std(1)), 1e-3)
         return np.abs(meda - medb) / sd
 
-    def phi_ess(ps):
-        return float(
-            np.mean(
-                np.asarray(
-                    jax.vmap(effective_sample_size)(
-                        jnp.asarray(ps[..., -1:])
-                    )
-                )
-            )
+    def ess_matrix(ps):
+        # (K, d) per-subset, per-parameter ESS
+        return np.asarray(
+            jax.vmap(effective_sample_size)(jnp.asarray(ps))
         )
+
+    def phi_ess(ps):
+        return float(np.mean(ess_matrix(ps)[:, -1]))
 
     g_wall = gaps(ps1, ps4)
     g_upd = gaps(ps1, ps4l)
+    # Monte-Carlo standard error of the median DIFFERENCE, in
+    # posterior-sd units: each arm's median carries sampling error
+    # ~ sqrt(pi/2) / sqrt(ESS) posterior sds (the asymptotic relative
+    # efficiency of the median), and the arms are independent chains.
+    # A fixed 1-sd max threshold is wrong at slow-mixing parameters
+    # (phi ESS ~ 10-15 here => SE of one gap ~ 0.5 sd, and the max
+    # over K x d comparisons of half-sd noise routinely exceeds 1);
+    # the calibrated criterion is the gap in units of ITS OWN SE.
+    se_upd = np.sqrt(np.pi / 2.0) * np.sqrt(
+        1.0 / np.maximum(ess_matrix(ps1), 2.0)
+        + 1.0 / np.maximum(ess_matrix(ps4l), 2.0)
+    )
+    g_upd_se = g_upd / se_upd
     out = {
         "m": M, "K": K, "iters": N_SAMPLES,
         "fit_s": {"phi1": round(t1, 1), "phi4": round(t4, 1),
@@ -141,11 +152,14 @@ def main():
             for i, n in enumerate(names)
         },
         "max_equal_updates_gap_in_sd": round(float(g_upd.max()), 3),
+        "max_equal_updates_gap_in_se": round(float(g_upd_se.max()), 3),
         # validity criterion: with the phi-update COUNT equalized the
         # schedules must agree — the every-4 schedule provably targets
-        # the same posterior, so only mixing (visible above in phi_ess
-        # and the equal-wallclock phi gap) may differ
-        "pass": bool(g_upd.max() < 1.0 and g_upd.mean() < 0.4),
+        # the same posterior (deterministic-scan Gibbs), so gaps are
+        # pure Monte-Carlo noise and must sit within a few standard
+        # errors of zero across all K x d comparisons; mean gap in
+        # posterior-sd units stays as a coarse absolute backstop
+        "pass": bool(g_upd_se.max() < 4.0 and g_upd.mean() < 0.4),
     }
     print(json.dumps(out), flush=True)
 

@@ -1,0 +1,142 @@
+"""Cross-check bench.py's analytic op model against XLA's own cost
+analysis of the compiled chunk program (VERDICT r2 weak #7: the
+eff-TFLOP/s / HBM-GB/s numbers the bench derives need an independent
+reference besides the measured roofline in BASELINE.md).
+
+For the bench solver configuration at a given (m, K), this compiles
+the same K-vmapped burn-chunk program bench.py times and prints, side
+by side, per MCMC iteration:
+
+  - XLA's flop count (``compiled.cost_analysis()['flops']``)
+  - XLA's HBM traffic estimate (``bytes accessed``)
+  - the analytic op_model's flops / bytes (bench.py)
+
+XLA's numbers come from the optimized HLO — post-fusion, including
+everything op_model deliberately ignores (elementwise, O(m) work,
+the phi-MH amortization realized via lax.cond in-scan) — so agreement
+within ~2x validates the model's altitude; large disagreement would
+mean the bench's utilization numbers describe the wrong program.
+
+Pure compile-time analysis: runs anywhere (defaults to the CPU
+backend's compiler off-TPU; pass through the axon tunnel for the real
+v5e lowering). Commit the output (XLA_COST_r03.json).
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import op_model
+from smk_tpu.config import PriorConfig, SMKConfig
+from smk_tpu.models.probit_gp import SpatialGPSampler
+from smk_tpu.parallel.executor import DATA_AXES, stacked_subset_data
+from smk_tpu.parallel.partition import Partition
+
+M = int(os.environ.get("COST_M", 3906))
+K = int(os.environ.get("COST_K", 32))
+Q = int(os.environ.get("COST_Q", 1))
+T = int(os.environ.get("COST_T", 64))
+CHUNK = int(os.environ.get("COST_CHUNK", 50))
+
+
+def main():
+    rng = np.random.default_rng(0)
+    part = Partition(
+        y=jnp.asarray(rng.integers(0, 2, (K, M, Q)), jnp.float32),
+        x=jnp.asarray(rng.normal(size=(K, M, Q, 2)), jnp.float32),
+        coords=jnp.asarray(rng.uniform(size=(K, M, 2)), jnp.float32),
+        mask=jnp.ones((K, M), jnp.float32),
+        index=jnp.zeros((K, M), jnp.int32),
+    )
+    ct = jnp.asarray(rng.uniform(size=(T, 2)), jnp.float32)
+    xt = jnp.asarray(rng.normal(size=(T, Q, 2)), jnp.float32)
+    data = stacked_subset_data(part, ct, xt)
+
+    cfg = SMKConfig(
+        n_subsets=K,
+        n_samples=5000,
+        cov_model="exponential",
+        u_solver="cg",
+        cg_iters=8,
+        cg_precond="nystrom",
+        cg_precond_rank=256,
+        cg_matvec_dtype="bfloat16",
+        phi_update_every=4,
+        priors=PriorConfig(a_prior="invwishart"),
+    )
+    model = SpatialGPSampler(cfg, weight=1)
+    keys = jax.random.split(jax.random.key(0), K)
+    init = jax.eval_shape(
+        lambda kk, d: jax.vmap(
+            lambda k1, d1: model.init_state(k1, d1, None),
+            in_axes=(0, DATA_AXES),
+        )(kk, d),
+        keys,
+        data,
+    )
+
+    fn = jax.jit(
+        jax.vmap(
+            lambda d, s, t: model.burn_chunk(d, s, t, CHUNK),
+            in_axes=(DATA_AXES, 0, None),
+        ),
+        donate_argnums=(1,),
+    )
+    compiled = fn.lower(data, init, jax.ShapeDtypeStruct((), jnp.int32)).compile()
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+
+    # XLA's cost analysis counts a While body ONCE, not x trip-count —
+    # so the compiled CHUNK-iteration scan program reports (to within
+    # the small outside-scan setup) the cost of ONE Gibbs iteration.
+    # Caveat on the phi lax.cond: both branches are in the body, so
+    # XLA's number carries the FULL phi Cholesky while the analytic
+    # model amortizes it by phi_update_every — the honest comparison
+    # is against the model at phi_update_every=1 (reported as
+    # model_*_phi1 below), with the amortized number alongside.
+    xla_flops_per_iter = float(ca.get("flops", float("nan")))
+    xla_bytes_per_iter = float(ca.get("bytes accessed", float("nan")))
+
+    # analytic model: n_iters=CHUNK burn iterations, no kriging
+    a_flops, a_bytes, parts = op_model(cfg, M, K, Q, CHUNK, 0, T)
+    import dataclasses as _dc
+
+    cfg1 = _dc.replace(cfg, phi_update_every=1)
+    a1_flops, a1_bytes, _ = op_model(cfg1, M, K, Q, CHUNK, 0, T)
+    out = {
+        "backend": jax.devices()[0].platform,
+        "m": M, "K": K, "q": Q, "chunk": CHUNK,
+        "solver": {
+            "cg_iters": cfg.cg_iters, "cg_precond": cfg.cg_precond,
+            "rank": cfg.cg_precond_rank,
+            "dtype": cfg.cg_matvec_dtype,
+            "phi_update_every": cfg.phi_update_every,
+        },
+        "xla_gflops_per_iter": round(xla_flops_per_iter / 1e9, 2),
+        "model_gflops_per_iter_phi1": round(a1_flops / CHUNK / 1e9, 2),
+        "model_gflops_per_iter_amortized": round(
+            a_flops / CHUNK / 1e9, 2
+        ),
+        "flops_ratio_xla_over_model_phi1": round(
+            xla_flops_per_iter / (a1_flops / CHUNK), 3
+        ),
+        "xla_gbytes_per_iter": round(xla_bytes_per_iter / 1e9, 3),
+        "model_gbytes_per_iter_phi1": round(a1_bytes / CHUNK / 1e9, 3),
+        "model_gbytes_per_iter_amortized": round(
+            a_bytes / CHUNK / 1e9, 3
+        ),
+        "bytes_ratio_xla_over_model_phi1": round(
+            xla_bytes_per_iter / (a1_bytes / CHUNK), 3
+        ),
+    }
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()
