@@ -1,0 +1,89 @@
+"""Shared harness for the on-chip evidence scripts.
+
+xla_cost_check.py and profile_trace.py must analyze EXACTLY the same
+compiled program (their artifacts cross-check each other), so the
+synthetic slice data, the r3 bench solver configuration, and the
+vmapped burn-chunk build live here once.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from smk_tpu.config import PriorConfig, SMKConfig
+from smk_tpu.models.probit_gp import SpatialGPSampler
+from smk_tpu.parallel.executor import DATA_AXES, stacked_subset_data
+from smk_tpu.parallel.partition import Partition
+
+
+def make_slice_data(m, k, q, t, seed=0):
+    """Synthetic stacked subset data at the profiling shape (contents
+    don't matter for cost/trace analysis — shapes and dtypes do)."""
+    rng = np.random.default_rng(seed)
+    part = Partition(
+        y=jnp.asarray(rng.integers(0, 2, (k, m, q)), jnp.float32),
+        x=jnp.asarray(rng.normal(size=(k, m, q, 2)), jnp.float32),
+        coords=jnp.asarray(rng.uniform(size=(k, m, 2)), jnp.float32),
+        mask=jnp.ones((k, m), jnp.float32),
+        index=jnp.zeros((k, m), jnp.int32),
+    )
+    ct = jnp.asarray(rng.uniform(size=(t, 2)), jnp.float32)
+    xt = jnp.asarray(rng.normal(size=(t, q, 2)), jnp.float32)
+    return stacked_subset_data(part, ct, xt)
+
+
+def bench_solver_config(k):
+    """The r3 bench solver defaults (bench.py run_rung) — change BOTH
+    there and here, or the committed evidence artifacts stop
+    describing the benched program."""
+    return SMKConfig(
+        n_subsets=k,
+        n_samples=5000,
+        cov_model="exponential",
+        u_solver="cg",
+        cg_iters=8,
+        cg_precond="nystrom",
+        cg_precond_rank=256,
+        cg_matvec_dtype="bfloat16",
+        phi_update_every=4,
+        priors=PriorConfig(a_prior="invwishart"),
+    )
+
+
+def build_chunk_program(cfg, data, chunk, k):
+    """(model, compiled burn-chunk) — jitted with the carried state
+    donated (without donation the carried chol_r, ~2 GB at the
+    config-5 slice, is held twice per dispatch and OOMs the chip).
+    Lowered against abstract init shapes so no device work happens."""
+    model = SpatialGPSampler(cfg, weight=1)
+    keys = jax.random.split(jax.random.key(0), k)
+    init_shape = jax.eval_shape(
+        lambda kk, d: jax.vmap(
+            lambda k1, d1: model.init_state(k1, d1, None),
+            in_axes=(0, DATA_AXES),
+        )(kk, d),
+        keys,
+        data,
+    )
+    fn = jax.jit(
+        jax.vmap(
+            lambda d, s, it: model.burn_chunk(d, s, it, chunk),
+            in_axes=(DATA_AXES, 0, None),
+        ),
+        donate_argnums=(1,),
+    )
+    compiled = fn.lower(
+        data, init_shape, jax.ShapeDtypeStruct((), jnp.int32)
+    ).compile()
+    return model, compiled
+
+
+def real_init_states(model, data, k):
+    """Concrete init states for scripts that execute the program."""
+    keys = jax.random.split(jax.random.key(0), k)
+    return jax.jit(
+        jax.vmap(
+            lambda k1, d1: model.init_state(k1, d1, None),
+            in_axes=(0, DATA_AXES),
+        )
+    )(keys, data)

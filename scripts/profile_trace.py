@@ -1,0 +1,165 @@
+"""jax.profiler trace of the north-star chunk program, summarized.
+
+Captures a real profiler trace (SURVEY.md §5.1) of one compiled
+burn-chunk execution at the config-5 slice and aggregates device-side
+op durations from the Chrome-trace export — no TensorBoard needed.
+Shares its data/config/program build with xla_cost_check.py via
+_slice_harness so the two committed artifacts describe the same
+program.
+
+Attribution model: the trace is hierarchical. The op names are
+structural (`while.N`, `conditional.N`, `fusion.N`), and for THIS
+program's lowering exactly two While ops exist — the outer Gibbs scan
+and the CG solve loop nested inside it — plus the phi-MH lax.cond.
+The summary asserts that structure instead of assuming it: if the
+lowering ever produces a different loop census (another link, q > 1,
+a new XLA version), the phase attribution is withheld and the raw
+per-while totals are emitted for manual mapping, rather than silently
+mislabeling a loop as the CG solve.
+
+Run on TPU:  python scripts/profile_trace.py
+Commit the output (TRACE_SUMMARY_r03.json).
+"""
+
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from scripts._slice_harness import (
+    bench_solver_config,
+    build_chunk_program,
+    make_slice_data,
+    real_init_states,
+)
+from smk_tpu.utils.tracing import device_sync
+
+M = int(os.environ.get("TRACE_M", 3906))
+K = int(os.environ.get("TRACE_K", 32))
+Q = int(os.environ.get("TRACE_Q", 1))
+T = int(os.environ.get("TRACE_T", 64))
+CHUNK = int(os.environ.get("TRACE_CHUNK", 50))
+
+
+def main():
+    data = make_slice_data(M, K, Q, T)
+    cfg = bench_solver_config(K)
+    model, compiled = build_chunk_program(cfg, data, CHUNK, K)
+    init = real_init_states(model, data, K)
+    device_sync(init.beta)
+
+    state = compiled(data, init, jnp.asarray(0))  # warm-up execution
+    device_sync(state.beta)
+
+    trace_dir = tempfile.mkdtemp(prefix="smk_trace_")
+    t0 = time.time()
+    jax.profiler.start_trace(trace_dir)
+    state = compiled(data, state, jnp.asarray(CHUNK))
+    device_sync(state.beta)
+    jax.profiler.stop_trace()
+    wall_s = time.time() - t0
+
+    paths = sorted(
+        glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                  recursive=True)
+    )
+    with gzip.open(paths[-1], "rt") as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+
+    # device pids: process_name metadata mentioning TPU/device
+    pid_names = {
+        e["pid"]: e["args"]["name"]
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+        and "args" in e
+    }
+    dev_pids = {
+        p for p, n in pid_names.items()
+        if re.search(r"TPU|device|/stream", n, re.I)
+        and not re.search(r"host|python", n, re.I)
+    }
+
+    by_name = {}
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
+            continue
+        dur = float(e.get("dur", 0.0))
+        if dur <= 0:
+            continue
+        by_name[e["name"]] = by_name.get(e["name"], 0.0) + dur
+
+    whiles = sorted(
+        ((n, us) for n, us in by_name.items()
+         if re.match(r"while", n, re.I)),
+        key=lambda kv: -kv[1],
+    )
+    conds = [
+        (n, us) for n, us in by_name.items()
+        if re.match(r"conditional", n)
+    ]
+    fusions = sorted(
+        ((n, us) for n, us in by_name.items()
+         if re.match(r"fusion|copy", n)),
+        key=lambda kv: -kv[1],
+    )[:10]
+
+    out = {
+        "device": str(jax.devices()[0]),
+        "m": M, "K": K, "q": Q, "chunk": CHUNK,
+        "wall_s": round(wall_s, 2),
+        "while_ops_ms_per_iter": [
+            {"op": n, "ms": round(us / 1e3 / CHUNK, 2)}
+            for n, us in whiles
+        ],
+        "conditional_ops_ms_per_iter": [
+            {"op": n, "ms": round(us / 1e3 / CHUNK, 2)}
+            for n, us in conds
+        ],
+        # the biggest leaf fusions (rebuild, Nystrom build, augment,
+        # elementwise) — raw evidence for the phase attribution
+        "top_fusions_ms_per_iter": [
+            {"op": n[:60], "ms": round(us / 1e3 / CHUNK, 3)}
+            for n, us in fusions
+        ],
+    }
+
+    # Phase attribution only when the loop census matches this
+    # program's known lowering (see module docstring). Loops below 1%
+    # of the largest (e.g. the truncated-normal rejection loop inside
+    # the augment fusion, ~0.06 ms/iter) are leaf noise, not phases.
+    big_whiles = [
+        (n, us) for n, us in whiles if us >= 0.01 * whiles[0][1]
+    ] if whiles else []
+    if len(big_whiles) == 2 and len(conds) == 1:
+        scan_us, cg_us = big_whiles[0][1], big_whiles[1][1]
+        cond_us = conds[0][1]
+        out["phase_ms_per_iter"] = {
+            "scan_body": round(scan_us / 1e3 / CHUNK, 2),
+            "cg_loop": round(cg_us / 1e3 / CHUNK, 2),
+            "phi_cond": round(cond_us / 1e3 / CHUNK, 2),
+            "rebuild_augment_rest": round(
+                (scan_us - cg_us - cond_us) / 1e3 / CHUNK, 2
+            ),
+        }
+    else:
+        out["phase_ms_per_iter"] = None
+        out["note"] = (
+            f"loop census ({len(big_whiles)} significant whiles, "
+            f"{len(conds)} conds) differs from the known lowering "
+            "(2, 1) — raw per-op rows above; map phases manually"
+        )
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

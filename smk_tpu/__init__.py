@@ -40,6 +40,12 @@ from smk_tpu.models.probit_gp import (
     SamplerState,
     SubsetResult,
 )
+from smk_tpu.parallel.recovery import (
+    SubsetNaNError,
+    find_failed_subsets,
+    rerun_subsets,
+)
+from smk_tpu.utils.tracing import debug_nans
 
 __version__ = "0.1.0"
 
@@ -58,4 +64,8 @@ __all__ = [
     "SpatialProbitGP",
     "SamplerState",
     "SubsetResult",
+    "SubsetNaNError",
+    "find_failed_subsets",
+    "rerun_subsets",
+    "debug_nans",
 ]
