@@ -68,11 +68,42 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# Persistent on-disk compilation cache: XLA compiles over the remote
+# tunnel cost 20-90 s per program and the ladder compiles ~10 programs
+# — across bench runs on the same machine the cache turns that ~300 s
+# of the budget into near-zero. Keyed by HLO + jaxlib + device, so a
+# solver-config change recompiles exactly what changed.
+try:  # pragma: no cover - environment-dependent
+    import tempfile
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.environ.get(
+            "BENCH_CACHE_DIR",
+            # per-user path: a world-shared /tmp name could be squatted
+            # (unwritable -> silently no cache) or pre-populated by
+            # another user (deserialized executables)
+            os.path.join(
+                tempfile.gettempdir(), f"smk_jax_cache_{os.getuid()}"
+            ),
+        ),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:
+    pass
+
 BASELINE_TARGET_S = 600.0
 
 
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("n", "q", "p", "n_features"))
 def make_binary_field(key, n, q=1, p=2, phi=6.0, n_features=256):
-    """Probit binary field with an RFF-approximated exponential GP."""
+    """Probit binary field with an RFF-approximated exponential GP.
+
+    Jitted as one program — the ~15 eager dispatches cost ~30 s at
+    n=125k over the remote-tunnel backend (bench setup budget)."""
     kc, kw, kb, kcoef, kx, ky = jax.random.split(key, 6)
     coords = jax.random.uniform(kc, (n, 2), jnp.float32)
     # exponential covariance = Matern-1/2; its spectral density is a

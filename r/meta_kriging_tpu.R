@@ -22,7 +22,11 @@
 #     n.core = 20,                 # K subsets (reference hardcoded 20)
 #     n.samples = 5000,            # MCMC budget (reference 100x50)
 #     backend = "tpu",
-#     combiner = "wasserstein_mean" # or "weiszfeld_median"
+#     combiner = "wasserstein_mean", # or "weiszfeld_median"
+#     config.overrides = list(      # any SMKConfig field, e.g. the
+#       u_solver = "cg",            # scaling-regime solver knobs
+#       cg_iters = 8L, cg_precond = "nystrom"
+#     )
 #   )
 #
 # Returned list mirrors the reference script's outputs:

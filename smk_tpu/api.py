@@ -77,10 +77,12 @@ def param_names(q: int, p: int) -> list[str]:
     return names
 
 
+@jax.jit
 def stacked_design(y: jnp.ndarray, x: jnp.ndarray):
     """Stack (n, q) responses and (n, q, p) designs into the long GLM
     layout the reference's warm start uses (R:53): response-major
-    blocks with a block-diagonal design."""
+    blocks with a block-diagonal design. Jitted: the q scatter ops
+    dispatched eagerly cost seconds at north-star n on the tunnel."""
     n, q, p = x.shape
     y_long = y.T.reshape(-1)  # (q*n,)
     x_long = jnp.zeros((q * n, q * p), x.dtype)

@@ -14,8 +14,10 @@ branching, static shapes, one small Cholesky solve per step.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.scipy.special import ndtr
@@ -44,6 +46,9 @@ def _link_quantities(eta: jnp.ndarray, link: str):
     return p, dmu
 
 
+@partial(
+    jax.jit, static_argnames=("weight", "link", "n_iter", "ridge")
+)
 def irls_glm(
     y: jnp.ndarray,
     x: jnp.ndarray,
@@ -59,6 +64,10 @@ def irls_glm(
     y: (n,) success counts in [0, weight]; x: (n, p) design;
     obs_mask: optional (n,) {0,1} mask for padded rows (SURVEY.md §7
     "ragged subsets" — padded observations contribute zero weight).
+
+    Jitted as ONE program: un-jitted, the ~25x4 eager IRLS ops each
+    pay a dispatch round-trip — ~40 s at the north-star n over the
+    remote-tunnel backend, vs one compile + one dispatch here.
     """
     n, p_dim = x.shape
     dtype = x.dtype
