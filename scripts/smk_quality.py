@@ -1,0 +1,151 @@
+"""SMK approximation quality at moderate scale, on-chip.
+
+The meta-kriging posterior is an approximation: K independent subset
+posteriors combined by quantile averaging (the 1-D Wasserstein-2
+barycenter, reference R:123-133). The unit tests check this at toy
+sizes on CPU (tests/test_meta_e2e.py); this script measures it at a
+scale where the full-data fit is still tractable on one chip —
+n=4000: a K=8 meta fit vs the K=1 full fit, identical model, solver,
+and MCMC budget, both through the public fit_meta_kriging pipeline.
+
+Reported per parameter (beta, K00, phi):
+  - posterior medians of both fits, gap in FULL-posterior sd units
+  - the W2 distance between the 200-point quantile grids relative to
+    the full posterior sd (the combiner's own geometry)
+plus the same W2 summary for the predicted latent surface at the
+shared test sites.
+
+What "good" looks like — and what cannot: the regression slopes and
+the latent surface (the p(y=1) prediction target) agree sub-sd across
+scales. The covariance scale K and range phi do NOT tighten toward
+the full posterior as n grows at fixed K_subsets: each subset applies
+the IW/Unif priors to only m observations of weakly-identifying
+binary data, so the combined posterior carries the prior's shrinkage
+effectively K times — an inherent property of the SMK method as
+published (the reference's per-subset spBayes priors behave
+identically, R:63-64), not an implementation artifact. Meanwhile the
+full posterior's sd shrinks ~1/sqrt(n), so gaps MEASURED IN FULL-SD
+UNITS grow with n even at fixed absolute accuracy. The pass criterion
+therefore scores what the method promises: slope recovery and the
+latent predictive surface; the K/phi rows are reported for
+transparency.
+
+Run on TPU (prints one JSON line to stdout; one line per QUAL_N):
+    python scripts/smk_quality.py >  SMK_QUALITY_r03.jsonl
+    QUAL_N=8000 python scripts/smk_quality.py >> SMK_QUALITY_r03.jsonl
+Commit SMK_QUALITY_r03.jsonl (the name BASELINE.md cites).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import make_binary_field
+from smk_tpu.api import fit_meta_kriging, param_names
+from smk_tpu.config import PriorConfig, SMKConfig
+
+N = int(os.environ.get("QUAL_N", 4000))
+K_META = int(os.environ.get("QUAL_K", 8))
+N_TEST = 64
+N_SAMPLES = int(os.environ.get("QUAL_SAMPLES", 5000))
+
+
+def fit(k, y, x, coords, ct, xt):
+    cfg = SMKConfig(
+        n_subsets=k,
+        n_samples=N_SAMPLES,
+        cov_model="exponential",
+        u_solver="cg",
+        cg_iters=8,
+        cg_precond="nystrom",
+        cg_precond_rank=256,
+        cg_matvec_dtype="bfloat16",
+        phi_update_every=4,
+        priors=PriorConfig(a_prior="invwishart"),
+    )
+    t0 = time.time()
+    res = fit_meta_kriging(
+        jax.random.key(1), y, x, coords, ct, xt, config=cfg,
+        chunk_iters=500,  # tunnel-safe dispatch
+        nan_guard=True,
+    )
+    return res, time.time() - t0
+
+
+def main():
+    y, x, coords = make_binary_field(jax.random.key(9), N + N_TEST, q=1, p=2)
+    y, x, coords, ct, xt = (
+        y[:N], x[:N], coords[:N], coords[N:], x[N:],
+    )
+
+    res_full, t_full = fit(1, y, x, coords, ct, xt)
+    res_meta, t_meta = fit(K_META, y, x, coords, ct, xt)
+
+    pg_full = np.asarray(res_full.param_grid)  # (200, d)
+    pg_meta = np.asarray(res_meta.param_grid)
+    names = param_names(1, 2)
+
+    # full-posterior spread from its own quantile grid (IQR/1.349
+    # is a robust sd; the grid rows are the quantile function)
+    q25 = int(0.25 * pg_full.shape[0])
+    q75 = int(0.75 * pg_full.shape[0])
+    sd_full = np.maximum(
+        (pg_full[q75] - pg_full[q25]) / 1.349, 1e-3
+    )
+    med_full = np.median(pg_full, axis=0)
+    med_meta = np.median(pg_meta, axis=0)
+    gap_sd = np.abs(med_meta - med_full) / sd_full
+    # W2 between quantile grids = rms difference of quantile functions
+    w2_rel = np.sqrt(np.mean((pg_meta - pg_full) ** 2, axis=0)) / sd_full
+
+    wg_full = np.asarray(res_full.w_grid)
+    wg_meta = np.asarray(res_meta.w_grid)
+    sd_w = np.maximum((wg_full[q75] - wg_full[q25]) / 1.349, 1e-3)
+    w2_w_rel = np.sqrt(np.mean((wg_meta - wg_full) ** 2, axis=0)) / sd_w
+
+    out = {
+        "n": N, "k_meta": K_META, "iters": N_SAMPLES,
+        "m_subset": -(-N // K_META),
+        "fit_s": {"full_k1": round(t_full, 1),
+                  "meta_k8": round(t_meta, 1)},
+        "median_full": {n: round(float(v), 4)
+                        for n, v in zip(names, med_full)},
+        "median_meta": {n: round(float(v), 4)
+                        for n, v in zip(names, med_meta)},
+        "median_gap_in_full_sd": {
+            n: round(float(v), 3) for n, v in zip(names, gap_sd)
+        },
+        "w2_rel_params": {
+            n: round(float(v), 3) for n, v in zip(names, w2_rel)
+        },
+        "w2_rel_latent_mean": round(float(np.mean(w2_w_rel)), 3),
+        "w2_rel_latent_max": round(float(np.max(w2_w_rel)), 3),
+        # score what SMK promises (module docstring): slope recovery
+        # + the latent predictive surface. K/phi rows stay reported
+        # above for transparency — their full-sd-unit gaps grow with
+        # n by the prior-counted-K-times mechanism inherent to the
+        # published method.
+        "pass": bool(
+            # slope columns located by name, not a hardcoded slice —
+            # survives a q/p change in the generator call above
+            float(
+                np.max(
+                    gap_sd[[i for i, n_ in enumerate(names)
+                            if n_.startswith("beta[")]]
+                )
+            ) < 1.5
+            and float(np.mean(w2_w_rel)) < 2.0
+        ),
+    }
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -20,6 +20,16 @@ stacked array axis:
 There are no host sockets or per-subset dispatch anywhere in the hot
 path — the reference's process boundary (SURVEY.md §3.2) becomes an
 array axis.
+
+Multi-host (DCN) scaling: after ``jax.distributed.initialize()``,
+``jax.devices()`` enumerates every chip in the job, so ``make_mesh()``
+builds a global mesh and the same sharded program spans hosts — XLA
+routes the only collective (the combiner's mean/median reduction over
+the K axis) over ICI within a slice and DCN across slices. Because
+subset fits exchange nothing (SURVEY.md §5.8), per-step DCN traffic
+is zero; scaling K across pods costs one quantile-grid-sized
+all-reduce at the very end, the same shape the reference's PSOCK
+gather shipped over localhost sockets.
 """
 
 from __future__ import annotations
