@@ -95,8 +95,10 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
   # config.overrides: named list merged into the SMKConfig call —
   # exposes every typed field (solver knobs like u_solver / cg_iters /
   # cg_precond, jitter, matmul_precision, ...) without enumerating
-  # them here; integer-valued fields must be passed as integers
-  # (e.g. list(u_solver = "cg", cg_iters = 8L, cg_precond = "nystrom"))
+  # them here. Plain R numerics are fine for the integer fields
+  # (SMKConfig coerces whole-valued doubles — reticulate sends R
+  # numerics as Python floats); e.g.
+  # list(u_solver = "cg", cg_iters = 8, cg_precond = "nystrom")
   cfg_args <- utils::modifyList(list(
     n_subsets = as.integer(n.core),
     n_samples = as.integer(n.samples),

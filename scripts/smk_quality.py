@@ -114,7 +114,7 @@ def main():
         "n": N, "k_meta": K_META, "iters": N_SAMPLES,
         "m_subset": -(-N // K_META),
         "fit_s": {"full_k1": round(t_full, 1),
-                  "meta_k8": round(t_meta, 1)},
+                  f"meta_k{K_META}": round(t_meta, 1)},
         "median_full": {n: round(float(v), 4)
                         for n, v in zip(names, med_full)},
         "median_meta": {n: round(float(v), 4)

@@ -197,7 +197,30 @@ class SMKConfig:
 
     priors: PriorConfig = dataclasses.field(default_factory=PriorConfig)
 
+    # Fields that must be ints (scan lengths, shapes, schedules).
+    # Coerced in __post_init__: the R front-end's config.overrides
+    # arrive as doubles through reticulate unless the user remembers
+    # 8L, and a float scan length fails much later with an opaque
+    # trace error instead of here.
+    _INT_FIELDS = (
+        "n_subsets", "n_samples", "n_quantiles", "resample_size",
+        "weiszfeld_iters", "phi_update_every", "cg_iters",
+        "cg_precond_rank", "chol_block_size", "pg_n_terms",
+    )
+
     def __post_init__(self):
+        for name in self._INT_FIELDS:
+            v = getattr(self, name)
+            if not isinstance(v, int):
+                try:
+                    ok = float(v) == int(v)
+                except (TypeError, ValueError):
+                    ok = False
+                if not ok:
+                    raise ValueError(
+                        f"{name} must be an integer, got {v!r}"
+                    )
+                object.__setattr__(self, name, int(v))
         if self.priors.a_prior not in ("normal", "invwishart"):
             raise ValueError(
                 "priors.a_prior must be 'normal' or 'invwishart'"

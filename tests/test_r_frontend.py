@@ -213,3 +213,19 @@ class TestConfigOverrides:
         assert cfg.cg_precond == "nystrom"
         assert cfg.cov_model == "matern32"  # override wins
         assert cfg.n_subsets == 4  # base survives
+
+    def test_integer_fields_coerced_from_r_doubles(self):
+        """reticulate passes R numerics as Python floats unless the
+        user writes 8L — SMKConfig coerces whole-valued floats on the
+        integer fields (scan lengths, shapes) and rejects fractional
+        ones with a clear error instead of an opaque trace failure."""
+        import smk_tpu as smk
+
+        cfg = smk.SMKConfig(
+            n_subsets=4.0, n_samples=60.0, cg_iters=8.0,
+            cg_precond_rank=64.0, phi_update_every=2.0,
+        )
+        assert cfg.n_subsets == 4 and isinstance(cfg.n_subsets, int)
+        assert cfg.cg_iters == 8 and isinstance(cfg.cg_iters, int)
+        with pytest.raises(ValueError, match="cg_iters"):
+            smk.SMKConfig(cg_iters=8.5)
