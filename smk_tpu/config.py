@@ -214,8 +214,8 @@ class SMKConfig:
             if not isinstance(v, int):
                 try:
                     ok = float(v) == int(v)
-                except (TypeError, ValueError):
-                    ok = False
+                except (TypeError, ValueError, OverflowError):
+                    ok = False  # OverflowError: int(float('inf'))
                 if not ok:
                     raise ValueError(
                         f"{name} must be an integer, got {v!r}"
