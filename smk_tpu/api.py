@@ -184,6 +184,37 @@ def fit_meta_kriging(
         jnp.asarray(a, dt) for a in (y, x, coords, coords_test, x_test)
     )
 
+    # Fail at the boundary with named shapes, not deep in an einsum:
+    # the reference's contract is y (n, q), x (n, q, p), coords
+    # (n, d), coords_test (t, d), x_test (t, q, p) (SURVEY.md §1.1).
+    if y.ndim != 2:
+        raise ValueError(
+            f"y must be (n, q) success counts, got shape {y.shape} — "
+            "a single response is y[:, None]"
+        )
+    n, q = y.shape
+    if x.ndim != 3 or x.shape[:2] != (n, q):
+        raise ValueError(
+            f"x must be (n={n}, q={q}, p) designs, got shape {x.shape}"
+        )
+    if coords.ndim != 2 or coords.shape[0] != n:
+        raise ValueError(
+            f"coords must be (n={n}, d) locations, got shape "
+            f"{coords.shape}"
+        )
+    if coords_test.ndim != 2 or coords_test.shape[1] != coords.shape[1]:
+        raise ValueError(
+            f"coords_test must be (t, d={coords.shape[1]}) locations, "
+            f"got shape {coords_test.shape}"
+        )
+    if x_test.ndim != 3 or x_test.shape != (
+        coords_test.shape[0], q, x.shape[2],
+    ):
+        raise ValueError(
+            f"x_test must be (t={coords_test.shape[0]}, q={q}, "
+            f"p={x.shape[2]}) designs, got shape {x_test.shape}"
+        )
+
     with phase_timer(times, "partition"):
         part = random_partition(k_part, y, x, coords, cfg.n_subsets)
         device_sync(part.y)

@@ -229,3 +229,43 @@ class TestConfigOverrides:
         assert cfg.cg_iters == 8 and isinstance(cfg.cg_iters, int)
         with pytest.raises(ValueError, match="cg_iters"):
             smk.SMKConfig(cg_iters=8.5)
+
+
+class TestInputShapeValidation:
+    """fit_meta_kriging fails at the boundary with named shapes — an
+    R user porting the reference passes y as a bare vector or designs
+    in (q, n, p) order and must get told so, not an einsum error."""
+
+    def _args(self):
+        rng = np.random.default_rng(0)
+        n, q, p, t = 40, 1, 2, 3
+        return dict(
+            y=rng.integers(0, 2, (n, q)).astype(np.float32),
+            x=rng.normal(size=(n, q, p)).astype(np.float32),
+            coords=rng.uniform(size=(n, 2)).astype(np.float32),
+            coords_test=rng.uniform(size=(t, 2)).astype(np.float32),
+            x_test=rng.normal(size=(t, q, p)).astype(np.float32),
+        )
+
+    @pytest.mark.parametrize(
+        "field,bad_shape,msg",
+        [
+            ("y", (40,), "y must be"),
+            ("x", (40, 2, 2), "x must be"),
+            ("coords", (39, 2), "coords must be"),
+            ("coords_test", (3, 3), "coords_test must be"),
+            ("x_test", (4, 1, 2), "x_test must be"),
+        ],
+    )
+    def test_bad_shapes_named(self, field, bad_shape, msg):
+        from smk_tpu.api import fit_meta_kriging
+        from smk_tpu.config import SMKConfig
+
+        args = self._args()
+        args[field] = np.zeros(bad_shape, np.float32)
+        with pytest.raises(ValueError, match=msg):
+            fit_meta_kriging(
+                jax.random.key(0), config=SMKConfig(
+                    n_subsets=2, n_samples=20, burn_in_frac=0.5
+                ), **args,
+            )
