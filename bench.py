@@ -141,30 +141,50 @@ def op_model(cfg, m, k, q, n_iters, n_kept, t):
         1 for i in range(n_iters) if i % cfg.phi_update_every == 0
     )
     per_comp = k * q
-    # CG: one m x m matvec per step; + final apply_r; + u_star L matvec
-    cg_flops = per_comp * n_iters * (cfg.cg_iters + 1) * 2 * m * m
-    if cfg.cg_precond == "nystrom":
-        # Nystrom factor build (tri_solve + inner Gram, O(m r^2) each)
-        # per sweep + two (m, r) matvecs per CG step
-        r_pc = min(cfg.cg_precond_rank, m)
-        cg_flops += per_comp * n_iters * (
-            3 * m * r_pc * r_pc + cfg.cg_iters * 4 * m * r_pc
-        )
+    if cfg.u_solver == "cg":
+        # CG: one m x m matvec per step; + final apply_r; + u_star L mv
+        cg_flops = per_comp * n_iters * (cfg.cg_iters + 1) * 2 * m * m
+        if cfg.cg_precond == "nystrom":
+            # Nystrom factor build (tri_solve + inner Gram, O(m r^2)),
+            # per phi update only (the factor is cached across non-phi
+            # sweeps) + Woodbury inner Gram per sweep + two (m, r)
+            # matvecs per CG step
+            r_pc = min(cfg.cg_precond_rank, m)
+            cg_flops += per_comp * n_phi * 2 * m * r_pc * r_pc
+            cg_flops += per_comp * n_iters * (
+                m * r_pc * r_pc + cfg.cg_iters * 4 * m * r_pc
+            )
+    else:
+        # dense path: (R + D) Cholesky + solve per sweep per component
+        cg_flops = per_comp * n_iters * (m**3 / 3 + 4 * m * m)
     ustar_flops = per_comp * n_iters * 2 * m * m
     # phi MH: proposal Cholesky m^3/3 + rebuild + two triangular solves
     chol_flops = per_comp * n_phi * (m**3 / 3 + 4 * m * m)
     # kriging (collect iters): v = trisolve(L, rc) m^2 t; cond_cov t^2 m
     krige_flops = per_comp * n_kept * (m * m * t + 2 * t * t * m)
     flops = cg_flops + ustar_flops + chol_flops + krige_flops
-    # HBM traffic: matrix streams per CG step + rebuild + carried reads
-    bytes_ = per_comp * n_iters * (
-        (cfg.cg_iters + 1) * mv_bytes * m * m  # CG + final matvec
-        + 4 * m * m  # dist read for the rebuild
-        + mv_bytes * m * m  # r_mv write
-        + 4 * m * m  # u_star: chol_r read
-    ) + per_comp * n_phi * (4 * 4 * m * m) + per_comp * n_kept * (4 * m * m)
-    if cfg.cg_precond == "nystrom":
-        # Z streamed twice per CG step + ~3 passes for the build
+    # HBM traffic: matrix streams per CG step + carried reads; the
+    # solve-operator rebuild (dist read + r_mv write) happens only on
+    # phi updates now that the operators are cached across sweeps
+    if cfg.u_solver == "cg":
+        bytes_ = per_comp * n_iters * (
+            (cfg.cg_iters + 1) * mv_bytes * m * m  # CG + final matvec
+            + 4 * m * m  # u_star: chol_r read
+        ) + per_comp * n_phi * (
+            4 * m * m  # dist read for the rebuild
+            + mv_bytes * m * m  # r_mv write
+        )
+    else:
+        bytes_ = per_comp * n_iters * (
+            4 * m * m  # dist read for the (R + D) rebuild
+            + 3 * 4 * m * m  # Cholesky working set + solve reads
+            + 4 * m * m  # u_star: chol_r read
+        )
+    bytes_ += per_comp * n_phi * (4 * 4 * m * m) + per_comp * n_kept * (
+        4 * m * m
+    )
+    if cfg.u_solver == "cg" and cfg.cg_precond == "nystrom":
+        # Z streamed twice per CG step + the Woodbury build pass
         r_pc = min(cfg.cg_precond_rank, m)
         bytes_ += per_comp * n_iters * (
             (2 * cfg.cg_iters + 3) * 4 * m * r_pc
@@ -267,7 +287,6 @@ def run_rung(name, *, n, k, cov_model, n_samples, q=1, p=2, n_test=64,
     from smk_tpu.ops.glm import glm_warm_start
     from smk_tpu.parallel.executor import DATA_AXES, stacked_subset_data
     from smk_tpu.parallel.partition import random_partition
-    from smk_tpu.utils.diagnostics import effective_sample_size
     from smk_tpu.utils.tracing import device_sync
 
     env = solver_env or {}
@@ -406,7 +425,10 @@ def run_rung(name, *, n, k, cov_model, n_samples, q=1, p=2, n_test=64,
                 "measured_ms_per_iter": round(per_iter * 1e3, 2),
                 "est_fit_s": round(est_fit_s, 1),
             }
-            if ci == 0 and progress is not None:
+            # emit at ci==0 and again at ci==1 if the gate was not yet
+            # open: a stalled first chunk would otherwise leave the
+            # outage rate as the last progress estimate on record
+            if progress is not None:
                 progress(est)
             elapsed_rung = time.time() - t_rung_start
             fits = (
@@ -464,27 +486,21 @@ def run_rung(name, *, n, k, cov_model, n_samples, q=1, p=2, n_test=64,
     }
 
     t0 = time.time()
-    # one jitted program for the diagnostics — unjitted vmap would
-    # execute op-by-op, each op a ~150 ms round-trip over the remote
-    # tunnel (this alone cost r2's bench several minutes per rung).
-    # Failed (non-finite) subsets are excluded from ESS and counted —
-    # the find_failed_subsets contract at bench scale.
+    # ESS/R-hat now come straight from the sampler's finalize (the
+    # public SubsetResult fields, VERDICT r3 #2) — one tiny jitted
+    # reduction masks out failed (non-finite) subsets and aggregates
+    # (per-op host round-trips cost ~150 ms each over the tunnel).
     @jax.jit
-    def diagnostics(w_samples, param_samples):
-        ok = jnp.isfinite(w_samples).all(axis=(1, 2)) & jnp.isfinite(
-            param_samples
+    def diagnostics(r):
+        ok = jnp.isfinite(r.w_samples).all(axis=(1, 2)) & jnp.isfinite(
+            r.param_samples
         ).all(axis=(1, 2))
-        ess_w = jax.vmap(effective_sample_size)(
-            jnp.where(ok[:, None, None], w_samples, 0.0)
-        )
-        ess_p = jax.vmap(effective_sample_size)(
-            jnp.where(ok[:, None, None], param_samples, 0.0)
-        )
-        # where(ok) not multiply: a zero-variance (masked-out) series
-        # can legitimately yield NaN ESS, and 0 * NaN = NaN
+        # where(ok) not multiply: a failed subset's ESS/R-hat can be
+        # NaN, and 0 * NaN = NaN
         return (
-            jnp.sum(jnp.where(ok[:, None], ess_w, 0.0)),
-            jnp.sum(jnp.where(ok[:, None], ess_p, 0.0)),
+            jnp.sum(jnp.where(ok[:, None], r.w_ess, 0.0)),
+            jnp.sum(jnp.where(ok[:, None], r.param_ess, 0.0)),
+            jnp.max(jnp.where(ok[:, None], r.param_rhat, 1.0)),
             jnp.sum(~ok),
         )
 
@@ -492,9 +508,8 @@ def run_rung(name, *, n, k, cov_model, n_samples, q=1, p=2, n_test=64,
     # fetches over the tunnel) — a failure here must not discard the
     # already-measured fit_s
     try:
-        ess_total, ess_par, n_failed = (
-            float(v)
-            for v in diagnostics(res.w_samples, res.param_samples)
+        ess_total, ess_par, rhat_max, n_failed = (
+            float(v) for v in diagnostics(res)
         )
         flops, bytes_, parts = op_model(
             cfg, m, k, q, n_samples, cfg.n_kept, n_test
@@ -507,6 +522,7 @@ def run_rung(name, *, n, k, cov_model, n_samples, q=1, p=2, n_test=64,
             "n_failed_subsets": int(n_failed),
             "latent_ess_per_sec": round(ess_total / fit_s, 1),
             "param_ess_per_sec": round(ess_par / fit_s, 1),
+            "param_rhat_max": round(rhat_max, 3),
             "phi_accept": round(
                 float(jnp.mean(res.phi_accept_rate)), 3
             ),

@@ -37,6 +37,14 @@
 #   $p.sample    predictive probability draws       (R:156-161)
 #   $param.quant / $w.quant / $p.quant  median + 95% CI (R:163-165)
 #   $phi.accept  per-subset MH acceptance (diagnostic)
+#   $ess         per-subset Geyer effective sample size per parameter
+#                (K x n_params; columns named by $param.names); with
+#                n_chains > 1 in config.overrides, summed over chains
+#   $rhat        per-subset split-R-hat per parameter (K x n_params;
+#                cross-chain when n_chains > 1) — values near 1 mean
+#                converged (the reference offered only acceptance
+#                printouts + traceplots, R:84,148-149)
+#   $w.ess / $w.rhat  the same per predicted latent (K x t*q)
 #   $phases      wall-clock per pipeline phase
 
 meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
@@ -145,6 +153,10 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
     w.quant = to_r(res$w_quant),
     p.quant = to_r(res$p_quant),
     phi.accept = to_r(res$phi_accept_rate),
+    ess = to_r(res$param_ess),
+    rhat = to_r(res$param_rhat),
+    w.ess = to_r(res$w_ess),
+    w.rhat = to_r(res$w_rhat),
     phases = res$phase_seconds,
     param.names = unlist(smk$api$param_names(as.integer(q), as.integer(p)))
   )

@@ -1,0 +1,97 @@
+"""Worker process for the 2-process DCN test (tests/test_distributed.py).
+
+Each invocation is one "host" of a 2-process JAX job on CPU (JAX's
+documented multi-process mode — the same ``jax.distributed`` machinery
+a multi-host TPU pod uses, with Gloo in place of DCN). Both workers
+build the identical small SMK problem from fixed seeds, join the
+coordination service, lay the K subsets over the 2-device GLOBAL mesh,
+run ``fit_subsets_sharded`` (each process executes its half of the
+subsets; zero cross-host traffic during the MCMC), reduce the combined
+quantile grid (the one collective — it crosses the process boundary),
+and print a digest for the test to compare against a single-process
+run of the same seeds.
+
+Usage: python scripts/_dcn_worker.py <process_id> <num_processes> <port>
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# one local CPU device per process: the test host exports the
+# 8-virtual-device XLA flag for its own process; workers must not
+# inherit it or the global mesh would be 16 devices for K=4
+os.environ["XLA_FLAGS"] = ""
+
+import jax
+
+# this environment's sitecustomize force-registers the TPU backend;
+# the override must go through jax.config (tests/conftest.py does the
+# same) and BEFORE jax.distributed.initialize touches the backend
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+
+    from smk_tpu.parallel.distributed import init_distributed
+
+    topo = init_distributed(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nprocs,
+        process_id=pid,
+    )
+
+    from smk_tpu.config import SMKConfig
+    from smk_tpu.models.probit_gp import SpatialGPSampler
+    from smk_tpu.parallel.combine import combine_quantile_grids
+    from smk_tpu.parallel.executor import fit_subsets_sharded, make_mesh
+    from smk_tpu.parallel.partition import random_partition
+
+    # identical problem on every process (global-array semantics need
+    # consistent host inputs) — same generator as the test's reference
+    key = jax.random.key(0)
+    n, q, p, t, k = 240, 1, 2, 6, 4
+    kc, kx, ky, kt = jax.random.split(key, 4)
+    coords = jax.random.uniform(kc, (n, 2))
+    x = jnp.concatenate(
+        [jnp.ones((n, q, 1)), jax.random.normal(kx, (n, q, p - 1))], -1
+    )
+    y = (jax.random.uniform(ky, (n, q)) < 0.5).astype(jnp.float32)
+    coords_test = jax.random.uniform(kt, (t, 2))
+    x_test = jnp.ones((t, q, p))
+
+    cfg = SMKConfig(
+        n_subsets=k, n_samples=40, u_solver="cg", cg_iters=16,
+        phi_update_every=2, n_quantiles=20,
+    )
+    model = SpatialGPSampler(cfg)
+    part = random_partition(jax.random.key(1), y, x, coords, k)
+
+    mesh = make_mesh()  # global: one device per process
+    res = fit_subsets_sharded(
+        model, part, coords_test, x_test, jax.random.key(2), mesh=mesh
+    )
+    # the combine is the single cross-host collective of the pipeline
+    combined = combine_quantile_grids(res.param_grid, cfg.combiner)
+    combined_w = combine_quantile_grids(res.w_grid, cfg.combiner)
+
+    out = {
+        "process_id": topo.process_id,
+        "num_processes": topo.num_processes,
+        "global_devices": topo.global_device_count,
+        "local_devices": topo.local_device_count,
+        "param_grid_shape": list(res.param_grid.shape),
+        "combined": np.asarray(combined).tolist(),
+        "combined_w_sum": float(np.asarray(combined_w).sum()),
+    }
+    print("DCN_RESULT " + json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

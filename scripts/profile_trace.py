@@ -72,6 +72,12 @@ def main():
         glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
                   recursive=True)
     )
+    if not paths:
+        sys.exit(
+            f"profiler produced no *.trace.json.gz under {trace_dir} — "
+            "the trace capture failed (tunnel drop or profiler not "
+            "supported on this backend); re-run"
+        )
     with gzip.open(paths[-1], "rt") as f:
         trace = json.load(f)
     events = trace["traceEvents"]
@@ -143,14 +149,22 @@ def main():
     if len(big_whiles) == 2 and len(conds) == 1:
         scan_us, cg_us = big_whiles[0][1], big_whiles[1][1]
         cond_us = conds[0][1]
+        rest_us = scan_us - cg_us - cond_us
         out["phase_ms_per_iter"] = {
             "scan_body": round(scan_us / 1e3 / CHUNK, 2),
             "cg_loop": round(cg_us / 1e3 / CHUNK, 2),
             "phi_cond": round(cond_us / 1e3 / CHUNK, 2),
             "rebuild_augment_rest": round(
-                (scan_us - cg_us - cond_us) / 1e3 / CHUNK, 2
+                max(rest_us, 0.0) / 1e3 / CHUNK, 2
             ),
         }
+        if rest_us < 0:
+            # the attribution model assumes the CG while and the phi
+            # cond nest inside the scan while; a negative remainder
+            # means they did not — flag it instead of emitting it
+            out["phase_ms_per_iter"]["rest_negative_flag"] = round(
+                rest_us / 1e3 / CHUNK, 2
+            )
     else:
         out["phase_ms_per_iter"] = None
         out["note"] = (

@@ -57,7 +57,7 @@ N_TEST = 64
 N_SAMPLES = int(os.environ.get("QUAL_SAMPLES", 5000))
 
 
-def fit(k, y, x, coords, ct, xt):
+def fit(k, y, x, coords, ct, xt, temper="none"):
     cfg = SMKConfig(
         n_subsets=k,
         n_samples=N_SAMPLES,
@@ -68,7 +68,7 @@ def fit(k, y, x, coords, ct, xt):
         cg_precond_rank=256,
         cg_matvec_dtype="bfloat16",
         phi_update_every=4,
-        priors=PriorConfig(a_prior="invwishart"),
+        priors=PriorConfig(a_prior="invwishart", temper=temper),
     )
     t0 = time.time()
     res = fit_meta_kriging(
@@ -87,9 +87,15 @@ def main():
 
     res_full, t_full = fit(1, y, x, coords, ct, xt)
     res_meta, t_meta = fit(K_META, y, x, coords, ct, xt)
+    # the r4 tempered-prior arm (PriorConfig.temper="power"): each
+    # subset prior raised to the 1/K power so the combination counts
+    # the prior once — the known fix for the prior-counted-K-times
+    # shrinkage on K/phi (VERDICT r3 #4)
+    res_temp, t_temp = fit(K_META, y, x, coords, ct, xt, temper="power")
 
     pg_full = np.asarray(res_full.param_grid)  # (200, d)
     pg_meta = np.asarray(res_meta.param_grid)
+    pg_temp = np.asarray(res_temp.param_grid)
     names = param_names(1, 2)
 
     # full-posterior spread from its own quantile grid (IQR/1.349
@@ -101,47 +107,69 @@ def main():
     )
     med_full = np.median(pg_full, axis=0)
     med_meta = np.median(pg_meta, axis=0)
+    med_temp = np.median(pg_temp, axis=0)
     gap_sd = np.abs(med_meta - med_full) / sd_full
+    gap_sd_t = np.abs(med_temp - med_full) / sd_full
     # W2 between quantile grids = rms difference of quantile functions
     w2_rel = np.sqrt(np.mean((pg_meta - pg_full) ** 2, axis=0)) / sd_full
 
     wg_full = np.asarray(res_full.w_grid)
     wg_meta = np.asarray(res_meta.w_grid)
+    wg_temp = np.asarray(res_temp.w_grid)
     sd_w = np.maximum((wg_full[q75] - wg_full[q25]) / 1.349, 1e-3)
     w2_w_rel = np.sqrt(np.mean((wg_meta - wg_full) ** 2, axis=0)) / sd_w
+    w2_w_rel_t = np.sqrt(np.mean((wg_temp - wg_full) ** 2, axis=0)) / sd_w
 
+    slope_ix = [i for i, n_ in enumerate(names) if n_.startswith("beta[")]
     out = {
         "n": N, "k_meta": K_META, "iters": N_SAMPLES,
         "m_subset": -(-N // K_META),
         "fit_s": {"full_k1": round(t_full, 1),
-                  f"meta_k{K_META}": round(t_meta, 1)},
+                  f"meta_k{K_META}": round(t_meta, 1),
+                  f"meta_k{K_META}_tempered": round(t_temp, 1)},
         "median_full": {n: round(float(v), 4)
                         for n, v in zip(names, med_full)},
         "median_meta": {n: round(float(v), 4)
                         for n, v in zip(names, med_meta)},
+        "median_meta_tempered": {n: round(float(v), 4)
+                                 for n, v in zip(names, med_temp)},
         "median_gap_in_full_sd": {
             n: round(float(v), 3) for n, v in zip(names, gap_sd)
+        },
+        "median_gap_in_full_sd_tempered": {
+            n: round(float(v), 3) for n, v in zip(names, gap_sd_t)
         },
         "w2_rel_params": {
             n: round(float(v), 3) for n, v in zip(names, w2_rel)
         },
         "w2_rel_latent_mean": round(float(np.mean(w2_w_rel)), 3),
         "w2_rel_latent_max": round(float(np.max(w2_w_rel)), 3),
+        "w2_rel_latent_mean_tempered": round(
+            float(np.mean(w2_w_rel_t)), 3
+        ),
         # score what SMK promises (module docstring): slope recovery
         # + the latent predictive surface. K/phi rows stay reported
         # above for transparency — their full-sd-unit gaps grow with
         # n by the prior-counted-K-times mechanism inherent to the
-        # published method.
+        # published method; the tempered arm is the fix and carries
+        # its own criterion below (VERDICT r3 #4).
         "pass": bool(
             # slope columns located by name, not a hardcoded slice —
             # survives a q/p change in the generator call above
-            float(
-                np.max(
-                    gap_sd[[i for i, n_ in enumerate(names)
-                            if n_.startswith("beta[")]]
-                )
-            ) < 1.5
+            float(np.max(gap_sd[slope_ix])) < 1.5
             and float(np.mean(w2_w_rel)) < 2.0
+        ),
+        # tempered criterion: K00/phi within ~1 full-sd of the full
+        # fit, slopes and surface no worse than the untempered arm
+        "pass_tempered": bool(
+            float(np.max(gap_sd_t[
+                [i for i, n_ in enumerate(names)
+                 if n_.startswith(("K[", "phi["))]
+            ])) < 1.0
+            and float(np.max(gap_sd_t[slope_ix]))
+            < float(np.max(gap_sd[slope_ix])) + 0.5
+            and float(np.mean(w2_w_rel_t))
+            < float(np.mean(w2_w_rel)) + 0.5
         ),
     }
     print(json.dumps(out), flush=True)

@@ -41,6 +41,19 @@ from smk_tpu.utils.tracing import device_sync
 M = int(os.environ.get("PHI_M", 1953))
 K = int(os.environ.get("PHI_K", 8))
 N_SAMPLES = int(os.environ.get("PHI_SAMPLES", 3000))
+# schedules compared: candidate PHI_B (default 4) against baseline
+# PHI_A (default 1 = every sweep). PHI_A=4 PHI_B=8 verifies the r4
+# phi/8 candidate against the already-verified phi/4 production
+# schedule without paying for the phi/1 arm again.
+PHI_A = int(os.environ.get("PHI_A", 1))
+PHI_B = int(os.environ.get("PHI_B", 4))
+if PHI_B <= PHI_A or PHI_B % PHI_A != 0:
+    sys.exit(
+        f"PHI_B ({PHI_B}) must be a proper multiple of PHI_A ({PHI_A}):"
+        " the equal-update-count arm runs the candidate for"
+        " (PHI_B/PHI_A) x N iterations, which only equalizes phi-update"
+        " counts when the ratio is an integer > 1"
+    )
 
 
 def fit(part, ct, xt, phi_update_every, n_samples):
@@ -92,14 +105,15 @@ def main():
 
     from smk_tpu.utils.diagnostics import effective_sample_size
 
-    # three arms:
-    #   phi1@N           — the exact every-sweep schedule
-    #   phi4@N           — equal wall-clock: shows the phi-ESS COST
-    #   phi4@4N          — equal phi-UPDATE count: shows the schedule
+    # three arms (A = PHI_A baseline schedule, B = PHI_B candidate):
+    #   phiA@N           — the baseline schedule
+    #   phiB@N           — equal wall-clock: shows the phi-ESS COST
+    #   phiB@(B/A)N      — equal phi-UPDATE count: shows the schedule
     #                      does not shift the target (validity)
-    ps1, acc1, t1 = fit(part, ct, xt, 1, N_SAMPLES)
-    ps4, acc4, t4 = fit(part, ct, xt, 4, N_SAMPLES)
-    ps4l, acc4l, t4l = fit(part, ct, xt, 4, 4 * N_SAMPLES)
+    ratio = PHI_B // PHI_A  # integer > 1, validated at import
+    ps1, acc1, t1 = fit(part, ct, xt, PHI_A, N_SAMPLES)
+    ps4, acc4, t4 = fit(part, ct, xt, PHI_B, N_SAMPLES)
+    ps4l, acc4l, t4l = fit(part, ct, xt, PHI_B, ratio * N_SAMPLES)
 
     names = ["beta0", "beta1", "K00", "phi"]
 
@@ -132,17 +146,19 @@ def main():
         + 1.0 / np.maximum(ess_matrix(ps4l), 2.0)
     )
     g_upd_se = g_upd / se_upd
+    la, lb = f"phi{PHI_A}", f"phi{PHI_B}"
     out = {
         "m": M, "K": K, "iters": N_SAMPLES,
-        "fit_s": {"phi1": round(t1, 1), "phi4": round(t4, 1),
-                  "phi4_4x": round(t4l, 1)},
-        "phi_accept": {"phi1": round(float(acc1.mean()), 3),
-                       "phi4": round(float(acc4.mean()), 3),
-                       "phi4_4x": round(float(acc4l.mean()), 3)},
+        "schedules": {"baseline": PHI_A, "candidate": PHI_B},
+        "fit_s": {la: round(t1, 1), lb: round(t4, 1),
+                  f"{lb}_{ratio}x": round(t4l, 1)},
+        "phi_accept": {la: round(float(acc1.mean()), 3),
+                       lb: round(float(acc4.mean()), 3),
+                       f"{lb}_{ratio}x": round(float(acc4l.mean()), 3)},
         # the cost: phi effective samples per kept draw under each arm
-        "phi_ess": {"phi1": round(phi_ess(ps1), 1),
-                    "phi4": round(phi_ess(ps4), 1),
-                    "phi4_4x": round(phi_ess(ps4l), 1)},
+        "phi_ess": {la: round(phi_ess(ps1), 1),
+                    lb: round(phi_ess(ps4), 1),
+                    f"{lb}_{ratio}x": round(phi_ess(ps4l), 1)},
         "equal_wallclock_gap_in_sd": {
             n: round(float(g_wall[:, i].mean()), 3)
             for i, n in enumerate(names)

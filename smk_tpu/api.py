@@ -50,6 +50,13 @@ class MetaKrigingResult(NamedTuple):
     subset_results : per-subset compressed posteriors (the gathered
         `obj` list, R:108) for checkpointing / shard re-runs.
     phi_accept_rate : (K, q) MH acceptance per subset.
+    param_ess / param_rhat : (K, n_params) per-subset Geyer ESS and
+        split-R-hat per parameter (cross-chain when config.n_chains
+        > 1) — the first-class convergence diagnostics of SURVEY.md
+        §5.5 (the reference only printed acceptance, R:84, and
+        eyeballed traceplots, R:148-149). Columns follow
+        ``param_names(q, p)``.
+    w_ess / w_rhat : (K, t*q) the same per predicted latent.
     phase_seconds : structured wall-clock per phase (replaces
         R:30,106,111).
     """
@@ -64,6 +71,10 @@ class MetaKrigingResult(NamedTuple):
     p_quant: jnp.ndarray
     subset_results: SubsetResult
     phi_accept_rate: jnp.ndarray
+    param_ess: jnp.ndarray
+    param_rhat: jnp.ndarray
+    w_ess: jnp.ndarray
+    w_rhat: jnp.ndarray
     phase_seconds: dict
 
 
@@ -298,5 +309,9 @@ def fit_meta_kriging(
         p_quant=p_quant,
         subset_results=results,
         phi_accept_rate=results.phi_accept_rate,
+        param_ess=results.param_ess,
+        param_rhat=results.param_rhat,
+        w_ess=results.w_ess,
+        w_rhat=results.w_rhat,
         phase_seconds=times.as_dict(),
     )

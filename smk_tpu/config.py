@@ -64,6 +64,18 @@ class PriorConfig:
     # also keeps the (q, p, p) factorization well-conditioned when a
     # subset's design is near-collinear.
     beta_scale: float = 100.0
+    # Prior tempering across the K subsets. The SMK combination
+    # effectively multiplies K subset posteriors, so every prior is
+    # counted K times — the shrinkage artifact measured in
+    # SMK_QUALITY_r03 (K[0,0] meta-median 3.1 full-posterior sd below
+    # the full fit at n=8000; the reference's per-subset priors behave
+    # identically, MetaKriging_BinaryResponse.R:63-64). "power" raises
+    # each subset's prior to the 1/n_subsets power: the beta and A
+    # normal precisions scale by 1/K, the IW density on K = A A^T
+    # exponentiates by 1/K (inside its MH prior ratio), and the flat
+    # phi prior is unaffected (a power of a uniform is uniform). The
+    # default "none" stays reference-faithful.
+    temper: str = "none"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -76,6 +88,15 @@ class SMKConfig:
     # MCMC budget (R:57-59, :85): n_samples total, burn-in fraction.
     n_samples: int = 5000
     burn_in_frac: float = 0.75
+
+    # Independent MCMC chains per subset — the "free extra vmap axis"
+    # (SURVEY.md §2.2; the reference runs exactly one chain per
+    # worker, R:80-84). Each chain runs the full n_samples budget
+    # under its own PRNG stream; kept draws are pooled before quantile
+    # compression, ESS sums over chains, and R-hat becomes a true
+    # cross-chain diagnostic. Memory scales linearly (each chain
+    # carries its own SamplerState incl. the O(m^2) factor).
+    n_chains: int = 1
 
     # Covariance model (R:84) and link (reference fits logit via
     # spBayes :80-84 and applies the logistic link at :160; the
@@ -116,8 +137,10 @@ class SMKConfig:
 
     # Solver for the u-update's (R + D) system: "chol" = exact dense
     # Cholesky; "cg" = fixed-iteration conjugate gradient with R
-    # applied directly (rebuilt elementwise from the distance matrix
-    # once per sweep) — O(cg_iters * m^2) of single-matvec work
+    # applied directly from a matvec matrix CARRIED across sweeps
+    # (models/probit_gp.py SolveCache — phi changes at most every
+    # phi_update_every-th sweep, so the matrix is refreshed only on
+    # phi-MH acceptance) — O(cg_iters * m^2) of single-matvec work
     # instead of O(m^3), the scaling-regime choice. The solve is HBM-
     # bandwidth-bound (each CG step streams the m x m matrix), so
     # cg_matvec_dtype="bfloat16" stores the matrix half-width and
@@ -138,7 +161,9 @@ class SMKConfig:
     # required to absorb the padded-row pseudo-variances. "nystrom":
     # rank-`cg_precond_rank` Nystrom approximation of R from the
     # subset's first r (randomly permuted) rows, applied by Woodbury —
-    # O(m r) per CG step on top of the O(m^2) matvec. The correlation
+    # O(m r) per CG step on top of the O(m^2) matvec; the phi-only
+    # factor Z is carried in the SolveCache, only the noise-shifted
+    # Woodbury inner system is rebuilt per sweep. The correlation
     # spectrum decays like k^-2 (Matern-1/2, 2D), so rank 256 leaves a
     # residual spectrum far below the noise shift and the solve
     # converges in ~8-10 steps instead of ~32 (measured at m=3906
@@ -203,18 +228,29 @@ class SMKConfig:
     # 8L, and a float scan length fails much later with an opaque
     # trace error instead of here.
     _INT_FIELDS = (
-        "n_subsets", "n_samples", "n_quantiles", "resample_size",
-        "weiszfeld_iters", "phi_update_every", "cg_iters",
-        "cg_precond_rank", "chol_block_size", "pg_n_terms",
+        "n_subsets", "n_samples", "n_chains", "n_quantiles",
+        "resample_size", "weiszfeld_iters", "phi_update_every",
+        "cg_iters", "cg_precond_rank", "chol_block_size", "pg_n_terms",
     )
 
     def __post_init__(self):
+        import numbers
+
         for name in self._INT_FIELDS:
             v = getattr(self, name)
+            # bool is an int subclass — cg_iters=True must be an
+            # error, not 1; coercion applies to real number types only
+            # (the R-double path), never to strings like "8"
+            if isinstance(v, bool):
+                raise ValueError(f"{name} must be an integer, got {v!r}")
             if not isinstance(v, int):
+                if not isinstance(v, numbers.Real):
+                    raise ValueError(
+                        f"{name} must be an integer, got {v!r}"
+                    )
                 try:
                     ok = float(v) == int(v)
-                except (TypeError, ValueError, OverflowError):
+                except (ValueError, OverflowError):
                     ok = False  # OverflowError: int(float('inf'))
                 if not ok:
                     raise ValueError(
@@ -225,6 +261,8 @@ class SMKConfig:
             raise ValueError(
                 "priors.a_prior must be 'normal' or 'invwishart'"
             )
+        if self.priors.temper not in ("none", "power"):
+            raise ValueError("priors.temper must be 'none' or 'power'")
         if self.priors.iw_df < 0 or self.priors.iw_scale <= 0:
             raise ValueError(
                 "priors.iw_df must be >= 0 (0 = use q) and iw_scale > 0"
@@ -255,6 +293,8 @@ class SMKConfig:
             raise ValueError("chol_block_size must be >= 0 (0 = XLA)")
         if self.phi_update_every < 1:
             raise ValueError("phi_update_every must be >= 1")
+        if self.n_chains < 1:
+            raise ValueError("n_chains must be >= 1")
         if not 0.0 < self.phi_target_accept < 1.0:
             raise ValueError("phi_target_accept must be in (0, 1)")
         if self.phi_step <= 0.0:

@@ -70,7 +70,8 @@ from smk_tpu.ops.chol import (
 )
 from smk_tpu.ops.cg import (
     cg_solve,
-    nystrom_preconditioner,
+    nystrom_apply,
+    nystrom_factor,
     shifted_correlation_operator,
 )
 from smk_tpu.ops.distance import cross_distance, pairwise_distance
@@ -117,15 +118,50 @@ class SamplerState(NamedTuple):
     # batch adaptation, R:83)
 
 
+class SolveCache(NamedTuple):
+    """phi-dependent solve operators carried across Gibbs sweeps.
+
+    With ``phi_update_every = e``, phi changes at most every e-th sweep
+    — yet round 3's trace billed ~20 of 68.5 ms/iter at the north-star
+    slice to rebuilding bit-identical matrices every sweep (the masked
+    correlation, its bfloat16 cast for the CG matvec, and the Nystrom
+    factor). These are pure functions of phi, so they ride the scan
+    carry NEXT TO SamplerState — not inside it, keeping the checkpoint
+    format untouched — and are refreshed only inside the phi-MH branch
+    on acceptance (where the proposal's correlation is built anyway).
+    Chunk boundaries rebuild the cache from state.phi, which is
+    deterministic and therefore bit-exact under any chunking.
+
+    r_mv:  (q, m, m) masked correlation in the CG matvec dtype
+           (bfloat16 at bench scale — half the HBM stream).
+    nys_z: (q, m, rank) Nystrom factor Z (ops/cg.py nystrom_factor),
+           or None when cg_precond != "nystrom".
+    """
+
+    r_mv: jnp.ndarray
+    nys_z: Optional[jnp.ndarray]
+
+
 class SubsetResult(NamedTuple):
     """What a subset ships home — mirrors the reference's compressed
-    return value `list(parameters=..., w.predict=...)` (R:89,95)."""
+    return value `list(parameters=..., w.predict=...)` (R:89,95), plus
+    the first-class convergence diagnostics the reference only ever
+    printed (acceptance lines, R:84) or eyeballed (traceplots,
+    R:148-149) — SURVEY.md §5.5 promotes ESS and R-hat to outputs.
+
+    With ``config.n_chains`` > 1 the kept draws are pooled across
+    chains (n_kept below = chains x per-chain kept), ESS is summed
+    over chains and R-hat is the true cross-chain split-R-hat."""
 
     param_grid: jnp.ndarray  # (n_quantiles, n_params)
     w_grid: jnp.ndarray  # (n_quantiles, t*q)
-    phi_accept_rate: jnp.ndarray  # (q,)
+    phi_accept_rate: jnp.ndarray  # (q,) (chain-averaged)
     param_samples: jnp.ndarray  # (n_kept, n_params) raw kept draws
     w_samples: jnp.ndarray  # (n_kept, t*q) raw kept predictive draws
+    param_ess: jnp.ndarray  # (n_params,) Geyer ESS per parameter
+    param_rhat: jnp.ndarray  # (n_params,) split-R-hat per parameter
+    w_ess: jnp.ndarray  # (t*q,) ESS per predicted latent
+    w_rhat: jnp.ndarray  # (t*q,) split-R-hat per predicted latent
 
 
 def n_params(q: int, p: int) -> int:
@@ -174,6 +210,41 @@ class SpatialGPSampler:
             return blocked_cholesky(r, jit_eff, cfg.chol_block_size)
         return jittered_cholesky(r, jit_eff)
 
+    def _mv_dtype(self, dtype):
+        return (
+            jnp.bfloat16
+            if self.config.cg_matvec_dtype == "bfloat16"
+            else dtype
+        )
+
+    def _cache_from_r(self, r_full: jnp.ndarray) -> SolveCache:
+        """Build the carried solve operators from a freshly built
+        (q, m, m) masked correlation (full precision)."""
+        cfg = self.config
+        m = r_full.shape[-1]
+        r_mv = r_full.astype(self._mv_dtype(r_full.dtype))
+        if cfg.cg_precond == "nystrom":
+            rank = min(cfg.cg_precond_rank, m)
+            nys_z = jax.vmap(lambda r: nystrom_factor(r[:, :rank]))(
+                r_full
+            )
+        else:
+            nys_z = None
+        return SolveCache(r_mv=r_mv, nys_z=nys_z)
+
+    def _solve_cache(self, dist, mask, phi) -> Optional[SolveCache]:
+        """Cache for the current phi — the scan-entry (and chunk-
+        boundary) build; deterministic in phi, so rebuilding here is
+        bit-identical to the carried value."""
+        cfg = self.config
+        if cfg.u_solver != "cg":
+            return None  # dense path: the O(m^2) rebuild is noise
+            # next to its O(m^3) per-sweep factorization
+        r_full = masked_correlation(
+            dist[None], phi[:, None, None], mask, cfg.cov_model
+        )
+        return self._cache_from_r(r_full)
+
     # ------------------------------------------------------------------
     # Initialization
     # ------------------------------------------------------------------
@@ -213,7 +284,8 @@ class SpatialGPSampler:
     # ------------------------------------------------------------------
     # One Gibbs iteration
     # ------------------------------------------------------------------
-    def _gibbs_step(self, data, consts, state, it, *, collect: bool):
+    def _gibbs_step(self, data, consts, carry, it, *, collect: bool):
+        state, cache = carry
         cfg = self.config
         weight = self.weight
         m, q, p = data.x.shape
@@ -246,12 +318,23 @@ class SpatialGPSampler:
                 zbar = (data.y - 0.5 * weight) / omega
             womega = omega * mask[:, None]  # masked precisions (m, q)
 
+        # Prior tempering (priors.temper="power"): each subset's prior
+        # raised to the 1/K power so the K-way combination counts the
+        # prior once, not K times (see PriorConfig.temper). ts scales
+        # every log prior density / Gaussian prior precision below;
+        # the flat phi prior needs nothing.
+        ts = (
+            1.0 / cfg.n_subsets
+            if cfg.priors.temper == "power"
+            else 1.0
+        )
+
         # --- 2. beta | z, w (conjugate, omega-weighted; near-flat
         # N(0, beta_scale^2) prior — its precision is the only ridge) -
         resid_b = zbar - w  # (m, q)
         prec_b = jnp.einsum("mqp,mq,mqr->qpr", data.x, womega, data.x)
         chol_pb = jittered_cholesky(
-            prec_b, 1.0 / cfg.priors.beta_scale**2
+            prec_b, ts / cfg.priors.beta_scale**2
         )
         rhs = jnp.einsum("mqp,mq->qp", data.x, womega * resid_b)
         mean_b = jax.vmap(chol_solve)(chol_pb, rhs)  # (q, p)
@@ -276,14 +359,6 @@ class SpatialGPSampler:
             )
 
         def phi_mh(_):
-            def chol_of(phis):
-                with jax.named_scope("phi_chol"):
-                    r = masked_correlation(
-                        dist[None], phis[:, None, None], mask,
-                        cfg.cov_model,
-                    )
-                    return self._chol_r(r)
-
             step = jnp.exp(state.phi_log_step)
             t_cur = jnp.log((phi - lo) / (hi - phi))
             t_prop = t_cur + step * jax.random.normal(kprop, (q,), dtype)
@@ -294,7 +369,12 @@ class SpatialGPSampler:
             log_jac_prop = jnp.log(sig_prop * (1.0 - sig_prop))
 
             chol_cur = state.chol_r  # factored when phi last changed
-            chol_prop = chol_of(phi_prop)
+            with jax.named_scope("phi_chol"):
+                r_prop = masked_correlation(
+                    dist[None], phi_prop[:, None, None], mask,
+                    cfg.cov_model,
+                )
+                chol_prop = self._chol_r(r_prop)
             log_ratio = (
                 u_loglik(chol_prop)
                 + log_jac_prop
@@ -304,21 +384,36 @@ class SpatialGPSampler:
             accept = jnp.log(
                 jax.random.uniform(kphi, (q,), dtype, minval=1e-12)
             ) < log_ratio
+            acc3 = accept[:, None, None]
+            if cache is None:
+                cache_new = None
+            else:
+                # the proposal's correlation is in hand — refresh the
+                # carried solve operators for accepted components only
+                with jax.named_scope("cache_refresh"):
+                    cache_prop = self._cache_from_r(r_prop)
+                cache_new = SolveCache(
+                    r_mv=jnp.where(acc3, cache_prop.r_mv, cache.r_mv),
+                    nys_z=None
+                    if cache.nys_z is None
+                    else jnp.where(acc3, cache_prop.nys_z, cache.nys_z),
+                )
             return (
                 jnp.where(accept, phi_prop, phi),
-                jnp.where(accept[:, None, None], chol_prop, chol_cur),
+                jnp.where(acc3, chol_prop, chol_cur),
                 accept.astype(dtype),
+                cache_new,
             )
 
         def phi_keep(_):
-            return phi, state.chol_r, jnp.zeros((q,), dtype)
+            return phi, state.chol_r, jnp.zeros((q,), dtype), cache
 
         if cfg.phi_update_every == 1:
             is_update = jnp.asarray(1.0, dtype)
-            phi, chol_r, accepted = phi_mh(None)
+            phi, chol_r, accepted, cache = phi_mh(None)
         else:
             is_update = (it % cfg.phi_update_every == 0).astype(dtype)
-            phi, chol_r, accepted = lax.cond(
+            phi, chol_r, accepted, cache = lax.cond(
                 it % cfg.phi_update_every == 0, phi_mh, phi_keep, None
             )
         phi_accept = state.phi_accept + accepted
@@ -366,40 +461,33 @@ class SpatialGPSampler:
             )
             rhs_vec = ytilde - u_star - eta_star
             if cfg.u_solver == "cg":
-                # (R + D) x = rhs with R applied *directly* — rebuilt
-                # elementwise from the distance matrix once per sweep
-                # (one m^2 read of dist), so each CG step is ONE m x m
-                # matvec instead of the two through the carried factor.
-                # The solve is HBM-bandwidth-bound (the matrix streams
-                # from HBM every step); cg_matvec_dtype="bfloat16"
-                # stores R half-width, halving that traffic, while the
-                # CG vectors and the accumulation stay in `dtype`.
-                # Jacobi preconditioning absorbs the huge padded-row
-                # d's; the jitter rides the diagonal term so the
-                # operator matches what chol_r factors.
-                mv_dtype = (
-                    jnp.bfloat16
-                    if cfg.cg_matvec_dtype == "bfloat16"
-                    else dtype
-                )
+                # (R + D) x = rhs with R applied *directly* from the
+                # CARRIED matvec matrix (SolveCache.r_mv — already in
+                # the matvec dtype), so each CG step is ONE m x m
+                # matvec instead of the two through the carried factor
+                # and no per-sweep rebuild/cast touches HBM. The solve
+                # is HBM-bandwidth-bound (the matrix streams from HBM
+                # every step); cg_matvec_dtype="bfloat16" stores R
+                # half-width, halving that traffic, while the CG
+                # vectors and the accumulation stay in `dtype`. Jacobi
+                # preconditioning absorbs the huge padded-row d's; the
+                # jitter rides the diagonal term so the operator
+                # matches what chol_r factors.
                 with jax.named_scope("u_cg_solve"):
-                    r_full = masked_correlation(
-                        dist, phi[j], mask, cfg.cov_model
-                    )
                     mv, diag, apply_r = shifted_correlation_operator(
-                        r_full, jit_eff + d_vec, mv_dtype, dtype
+                        cache.r_mv[j], jit_eff + d_vec,
+                        self._mv_dtype(dtype), dtype,
                     )
                     if cfg.cg_precond == "nystrom":
                         # Landmarks = the subset's first r rows (a
                         # uniform spatial sample after the partition
-                        # permutation). Rebuilt per sweep: the Nystrom
-                        # factor is O(m r^2) of GEMM work — trivial
-                        # next to even one m x m matvec stream — and
-                        # keeping it out of the carried state leaves
-                        # the checkpoint format untouched.
-                        rank = min(cfg.cg_precond_rank, m)
-                        pre = nystrom_preconditioner(
-                            r_full[:, :rank], jit_eff + d_vec
+                        # permutation). The factor Z is carried in the
+                        # cache (phi-only); the Woodbury inner system
+                        # is rebuilt here because the noise shift
+                        # changes every sweep — O(m r^2), trivial next
+                        # to one m x m matvec stream.
+                        pre = nystrom_apply(
+                            cache.nys_z[j], jit_eff + d_vec
                         )
                         s = cg_solve(
                             mv, rhs_vec, cfg.cg_iters, precond=pre
@@ -431,7 +519,7 @@ class SpatialGPSampler:
         # N(0, a_scale^2) working prior. Rows are conditionally
         # independent given U. q is small and static, so the ragged
         # row dimension is a plain unrolled Python loop.
-        prior_prec = 1.0 / jnp.asarray(cfg.priors.a_scale, dtype) ** 2
+        prior_prec = ts / jnp.asarray(cfg.priors.a_scale, dtype) ** 2
         ka_rows = jax.random.split(ka, q + 1)
         a_new = jnp.zeros_like(a)
         for l in range(q):
@@ -459,8 +547,19 @@ class SpatialGPSampler:
             s_iw = jnp.asarray(cfg.priors.iw_scale, dtype)
 
             def log_prior_ratio(a_mat):
-                # log pIW(K(A)) + log|dK/dA| - log pN(A), dropping
-                # A-independent constants.
+                # ts * log pIW(K(A)) + log|dK/dA| - log pN(A),
+                # dropping A-independent constants. ts tempers the IW
+                # DENSITY only: each subset's K-marginal posterior is
+                # L_k(K) pIW(K) (the K->A Jacobian cancels when the
+                # A-space posterior is expressed as a K-space
+                # density), so the K-way product over-counts exactly
+                # pIW^K — the Jacobian is a change of measure that
+                # appears once per subset and must stay whole, or the
+                # combination would retain an |J|^(1-K) spike at
+                # singular A. The proposal's working-normal density
+                # lp_n is a proposal correction, not a prior, but its
+                # precision variable is already ts-scaled so proposal
+                # and target widen together.
                 diag = jnp.abs(jnp.diagonal(a_mat)) + 1e-30
                 # |K| = prod diag^2; Jacobian = 2^q prod diag^(q-i+1)
                 jac = jnp.sum(
@@ -476,7 +575,7 @@ class SpatialGPSampler:
                 lp_n = -0.5 * prior_prec * jnp.sum(
                     a_mat[tril_r_, tril_c_] ** 2
                 )
-                return lp_iw + jac - lp_n
+                return ts * lp_iw + jac - lp_n
 
             log_alpha = log_prior_ratio(a_new) - log_prior_ratio(a)
             acc_a = jnp.log(
@@ -491,7 +590,7 @@ class SpatialGPSampler:
             phi_accept=phi_accept, phi_log_step=phi_log_step,
         )
         if not collect:
-            return new_state, None
+            return (new_state, cache), None
 
         # --- 6. predictive kriging draw (spPredict equivalent) --------
         # Pad rows of the cross-covariance are zeroed: pad latents are
@@ -529,7 +628,7 @@ class SpatialGPSampler:
         params = jnp.concatenate(
             [beta.reshape(-1), k_mat[tril_r, tril_c], phi]
         )
-        return new_state, (params, w_star)
+        return (new_state, cache), (params, w_star)
 
     # ------------------------------------------------------------------
     # Full run
@@ -563,6 +662,25 @@ class SpatialGPSampler:
         )
         return self.finalize(state, param_draws, w_draws)
 
+    def run_chains(self, data, init_states) -> SubsetResult:
+        """Multi-chain run: ``init_states`` is a SamplerState pytree
+        whose leaves carry a leading ``config.n_chains`` axis (one
+        independent PRNG stream per chain — the "free extra vmap axis"
+        of SURVEY.md §2.2). Chains advance in lockstep under vmap;
+        finalize pools their draws, sums ESS and spans R-hat across
+        them. Pure function of (data, init_states) like ``run``."""
+        cfg = self.config
+        with jax.default_matmul_precision(cfg.matmul_precision):
+            states = jax.vmap(lambda s: self._burn_in(data, s))(
+                init_states
+            )
+            states, (param_draws, w_draws) = jax.vmap(
+                lambda s: self._sample_chunk(
+                    data, s, jnp.asarray(cfg.n_burn_in), cfg.n_kept
+                )
+            )(states)
+            return self.finalize(states, param_draws, w_draws)
+
     # -- resumable pieces (used by run() and the checkpointed executor,
     # parallel/resume.py; chunking the sampling scan changes nothing:
     # the PRNG sequence lives in the carried state) -------------------
@@ -583,12 +701,15 @@ class SpatialGPSampler:
 
     def _burn_in(self, data, init_state):
         consts = self._consts(data)
+        cache = self._solve_cache(
+            consts[0], data.mask, init_state.phi
+        )
         step = lambda st, it: (
             self._gibbs_step(data, consts, st, it, collect=False)[0],
             None,
         )
-        state, _ = lax.scan(
-            step, init_state, jnp.arange(self.config.n_burn_in)
+        (state, _), _ = lax.scan(
+            step, (init_state, cache), jnp.arange(self.config.n_burn_in)
         )
         return state._replace(phi_accept=jnp.zeros_like(state.phi_accept))
 
@@ -608,12 +729,13 @@ class SpatialGPSampler:
         rates are post-burn-in."""
         with jax.default_matmul_precision(self.config.matmul_precision):
             consts = self._consts(data)
+            cache = self._solve_cache(consts[0], data.mask, state.phi)
             step = lambda st, it: (
                 self._gibbs_step(data, consts, st, it, collect=False)[0],
                 None,
             )
-            state, _ = lax.scan(
-                step, state, start_it + jnp.arange(n_iters)
+            (state, _), _ = lax.scan(
+                step, (state, cache), start_it + jnp.arange(n_iters)
             )
             return state
 
@@ -634,28 +756,50 @@ class SpatialGPSampler:
 
     def _sample_chunk(self, data, state, start_it, n_iters):
         consts = self._consts(data)
+        cache = self._solve_cache(consts[0], data.mask, state.phi)
         step = lambda st, it: self._gibbs_step(
             data, consts, st, it, collect=True
         )
         iters = start_it + jnp.arange(n_iters)
-        return lax.scan(step, state, iters)
+        (state, _), draws = lax.scan(step, (state, cache), iters)
+        return state, draws
 
     def finalize(self, state, param_draws, w_draws) -> SubsetResult:
-        """Compression + diagnostics over the full kept-draw arrays."""
+        """Compression + on-device diagnostics over the kept draws.
+
+        Accepts single-chain draws of shape (n_kept, d) or stacked
+        chains (n_chains, n_kept, d); chains are pooled for the
+        quantile grids and sample outputs, ESS sums over chains, and
+        R-hat spans them (utils/diagnostics.rhat).
+        """
+        from smk_tpu.utils.diagnostics import effective_sample_size, rhat
+
         cfg = self.config
         n_phi_updates = sum(
             1
             for i in range(cfg.n_burn_in, cfg.n_samples)
             if i % cfg.phi_update_every == 0
         )
-        param_grid = quantile_grid(param_draws, cfg.n_quantiles)
-        w_grid = quantile_grid(w_draws, cfg.n_quantiles)
+        chains_p = param_draws[None] if param_draws.ndim == 2 else param_draws
+        chains_w = w_draws[None] if w_draws.ndim == 2 else w_draws
+        pooled_p = chains_p.reshape(-1, chains_p.shape[-1])
+        pooled_w = chains_w.reshape(-1, chains_w.shape[-1])
+        param_grid = quantile_grid(pooled_p, cfg.n_quantiles)
+        w_grid = quantile_grid(pooled_w, cfg.n_quantiles)
+        ess_c = jax.vmap(effective_sample_size)
+        phi_accept = state.phi_accept / float(max(n_phi_updates, 1))
+        if phi_accept.ndim == 2:  # (n_chains, q) -> chain average
+            phi_accept = jnp.mean(phi_accept, axis=0)
         return SubsetResult(
             param_grid=param_grid,
             w_grid=w_grid,
-            phi_accept_rate=state.phi_accept / float(max(n_phi_updates, 1)),
-            param_samples=param_draws,
-            w_samples=w_draws,
+            phi_accept_rate=phi_accept,
+            param_samples=pooled_p,
+            w_samples=pooled_w,
+            param_ess=jnp.sum(ess_c(chains_p), axis=0),
+            param_rhat=rhat(chains_p),
+            w_ess=jnp.sum(ess_c(chains_w), axis=0),
+            w_rhat=rhat(chains_w),
         )
 
 

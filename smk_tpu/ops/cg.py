@@ -55,6 +55,63 @@ def shifted_correlation_operator(r, shift, matvec_dtype, acc_dtype):
     return matvec, 1.0 + shift, apply_r
 
 
+def nystrom_factor(
+    k_mr: jnp.ndarray, rr_jitter: float = 1e-4
+) -> jnp.ndarray:
+    """The shift-independent half of the Nystrom preconditioner:
+    Z = K_mr chol(K_rr)^{-T}, so Z Z^T is the rank-r Nystrom
+    approximation of R from the first-r-rows landmarks.
+
+    Z depends only on R (i.e. on phi) — the sampler caches it across
+    Gibbs sweeps beside the bf16 matvec matrix and rebuilds it only
+    when a phi-MH proposal is accepted (models/probit_gp.py step 3);
+    the per-sweep noise shift enters via ``nystrom_apply`` below.
+
+    Explicit small inverse instead of per-application triangular
+    solves: TPU trisolves are latency-bound (sequential panel
+    recurrence), and at r <= 256 on SPD, jitter-regularized blocks the
+    explicit inverse is both tiny and safe — the factor build becomes
+    pure (m, r) GEMM that rides the MXU (measured: the trisolve form
+    cost ~2x the matvec savings it enabled at m=3906).
+    """
+    r = k_mr.shape[1]
+    eye_r = jnp.eye(r, dtype=k_mr.dtype)
+    l_rr = jittered_cholesky(k_mr[:r, :], rr_jitter)
+    inv_l = tri_solve(l_rr, eye_r)  # (r, r) = L_rr^{-1}
+    return k_mr @ inv_l.T  # (m, r)
+
+
+def nystrom_apply(
+    z: jnp.ndarray, shift: jnp.ndarray
+) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    """Woodbury application v -> M^{-1} v for M = Z Z^T + diag(shift),
+    given a prebuilt Nystrom factor ``z`` (see nystrom_factor).
+
+      M^{-1} = S - S Z (I_r + Z^T S Z)^{-1} Z^T S,  S = diag(shift)^{-1}
+
+    The (r, r) inner system is rebuilt here because ``shift`` carries
+    the per-sweep noise variances; it costs one O(m r^2) GEMM + an
+    O(r^3) Cholesky — trivial next to a single m x m CG matvec. Each
+    application is then two (m, r) matvecs + an (r, r) GEMM pair.
+
+    The returned closure accepts 1-D (m,) vectors only (the sampler's
+    per-component solves); cg_solve's batched-b form needs a batched
+    preconditioner the caller would build with vmap.
+    """
+    m, r = z.shape
+    eye_r = jnp.eye(r, dtype=z.dtype)
+    s = 1.0 / (jnp.zeros((m,), z.dtype) + shift)
+    w = z * s[:, None]
+    # I_r + Z^T S Z is SPD by construction (identity + PSD Gram)
+    c = jittered_cholesky(eye_r + z.T @ w, 0.0)
+    e = chol_solve(c, eye_r)  # (r, r) inner inverse
+
+    def precond(v):
+        return s * v - w @ (e @ (w.T @ v))
+
+    return precond
+
+
 def nystrom_preconditioner(
     k_mr: jnp.ndarray,
     shift: jnp.ndarray,
@@ -91,30 +148,11 @@ def nystrom_preconditioner(
 
     The returned closure accepts 1-D (m,) vectors only (the sampler's
     per-component solves); cg_solve's batched-b form needs a batched
-    preconditioner the caller would build with vmap.
+    preconditioner the caller would build with vmap. One-shot
+    composition of nystrom_factor + nystrom_apply (the sampler calls
+    the two halves separately to cache the factor across sweeps).
     """
-    m, r = k_mr.shape
-    eye_r = jnp.eye(r, dtype=k_mr.dtype)
-    l_rr = jittered_cholesky(k_mr[:r, :], rr_jitter)
-    # Explicit small inverses instead of per-application triangular
-    # solves: TPU trisolves are latency-bound (sequential panel
-    # recurrence), and at r <= 256 on SPD, jitter-regularized blocks
-    # the explicit inverse is both tiny and safe — the factor build
-    # and every preconditioner application become pure (m, r) GEMMs
-    # that ride the MXU (measured: the trisolve form cost ~2x the
-    # matvec savings it enabled at m=3906).
-    inv_l = tri_solve(l_rr, eye_r)  # (r, r) = L_rr^{-1}
-    z = k_mr @ inv_l.T  # (m, r) Nystrom factor
-    s = 1.0 / (jnp.zeros((m,), k_mr.dtype) + shift)
-    w = z * s[:, None]
-    # I_r + Z^T S Z is SPD by construction (identity + PSD Gram)
-    c = jittered_cholesky(eye_r + z.T @ w, 0.0)
-    e = chol_solve(c, eye_r)  # (r, r) inner inverse
-
-    def precond(v):
-        return s * v - w @ (e @ (w.T @ v))
-
-    return precond
+    return nystrom_apply(nystrom_factor(k_mr, rr_jitter), shift)
 
 
 def cg_solve(

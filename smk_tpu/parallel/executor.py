@@ -67,6 +67,39 @@ _DATA_AXES = DATA_AXES
 _stacked_data = stacked_subset_data
 
 
+def subset_chain_keys(key: jax.Array, k: int, n_chains: int):
+    """Per-(subset, chain) PRNG keys: (k,) when n_chains == 1 (the
+    historical layout — golden chains are unchanged), else
+    (k, n_chains) (trailing raw-key dims preserved for legacy uint32
+    keys)."""
+    if n_chains == 1:
+        return jax.random.split(key, k)
+    ks = jax.random.split(key, k * n_chains)
+    return ks.reshape((k, n_chains) + ks.shape[1:])
+
+
+def init_subset_states(model, keys, data, beta_init):
+    """vmap init_state over the K axis — and over the chain axis too
+    when model.config.n_chains > 1 (keys then carry (K, C) leading
+    axes; the data is shared across a subset's chains)."""
+    init_fn = lambda kk, d: model.init_state(kk, d, beta_init)
+    if model.config.n_chains > 1:
+        return jax.vmap(
+            jax.vmap(init_fn, in_axes=(0, None)),
+            in_axes=(0, DATA_AXES),
+        )(keys, data)
+    return jax.vmap(init_fn, in_axes=(0, DATA_AXES))(keys, data)
+
+
+def subset_runner(model):
+    """The per-subset fit entry point the executors vmap over K:
+    ``run`` for a single chain, ``run_chains`` when the config asks
+    for several (the extra chain axis lives inside the per-subset
+    program, so every K-fan-out path — vmap, sharded, chunked —
+    composes with it unchanged)."""
+    return model.run_chains if model.config.n_chains > 1 else model.run
+
+
 def fit_subsets_vmap(
     model: SpatialGPSampler,
     part: Partition,
@@ -86,12 +119,10 @@ def fit_subsets_vmap(
     """
     k = part.n_subsets
     data = _stacked_data(part, coords_test, x_test)
-    keys = jax.random.split(key, k)
-    init = jax.vmap(lambda kk, d: model.init_state(kk, d, beta_init), in_axes=(0, _DATA_AXES))(
-        keys, data
-    )
+    keys = subset_chain_keys(key, k, model.config.n_chains)
+    init = init_subset_states(model, keys, data, beta_init)
 
-    runner = jax.vmap(model.run, in_axes=(_DATA_AXES, 0))
+    runner = jax.vmap(subset_runner(model), in_axes=(_DATA_AXES, 0))
     if chunk_size is None or chunk_size >= k:
         return runner(data, init)
 

@@ -35,16 +35,23 @@ from smk_tpu.models.probit_gp import (
     SubsetResult,
     n_params,
 )
-from smk_tpu.parallel.executor import DATA_AXES, stacked_subset_data
+from smk_tpu.parallel.executor import (
+    DATA_AXES,
+    init_subset_states,
+    stacked_subset_data,
+    subset_chain_keys,
+    subset_runner,
+)
 from smk_tpu.parallel.partition import Partition
 from smk_tpu.utils.checkpoint import load_pytree, save_pytree
 
 
 # Checkpoint format version. v2 added the run-identity fingerprint;
-# v3 the explicit iteration counter (burn-in chunks checkpoint too). A
-# bump invalidates older files with a clear error instead of a generic
-# structure mismatch.
-CKPT_VERSION = 3
+# v3 the explicit iteration counter (burn-in chunks checkpoint too);
+# v4 the n_chains meta field + the sampled (no full-array host fetch)
+# run-identity scheme. A bump invalidates older files with a clear
+# error instead of a generic structure mismatch.
+CKPT_VERSION = 4
 
 
 class SubsetNaNError(RuntimeError):
@@ -90,41 +97,94 @@ def _key_bytes(key) -> bytes:
     return np.ascontiguousarray(key).tobytes()
 
 
+_IDENT_SAMPLE = 4096  # elements hashed per data leaf
+
+
+@jax.jit
+def _leaf_checksum(flat_u32: jnp.ndarray) -> jnp.ndarray:
+    """(2,) uint32 device-side checksum covering EVERY element: the
+    wraparound sum of the raw bit patterns plus a position-weighted
+    wraparound sum. Any single-element change moves the plain sum
+    (its pattern delta is nonzero mod 2^32); reorderings and paired
+    edits that cancel in the plain sum almost surely move the
+    weighted one. Plain adds/multiplies only — unlike a custom
+    bitwise-XOR lax.reduce, this lowers on every backend INCLUDING
+    mesh-sharded inputs (the sharded checkpoint path hands this
+    function NamedSharding-laid-out leaves)."""
+    weights = jax.lax.iota(jnp.uint32, flat_u32.shape[0]) + jnp.uint32(1)
+    return jnp.stack([
+        jnp.sum(flat_u32, dtype=jnp.uint32),
+        jnp.sum(flat_u32 * weights, dtype=jnp.uint32),
+    ])
+
+
+def _leaf_fingerprint(leaf) -> int:
+    """CRC of a leaf's shape/dtype + an exact on-device checksum + a
+    strided element sample.
+
+    The v3 scheme CRC'd every byte of every partitioned leaf — at
+    north-star scale a multi-GB device->host fetch before the first
+    chunk of every checkpointed run. Here the whole-array work (a
+    bitwise XOR-reduce and a mod-2^32 sum of element bit patterns)
+    runs on device, so EVERY element participates — a single changed
+    row anywhere flips the checksum — while only 2 scalars plus a
+    <= _IDENT_SAMPLE-element strided sample (which pins down WHERE
+    values live, catching e.g. swapped leaves with equal multisets)
+    cross to host."""
+    arr = jnp.asarray(leaf).reshape(-1)
+    n = int(arr.shape[0])
+    h = zlib.crc32(repr((jnp.shape(leaf), str(arr.dtype))).encode())
+    if n == 0:
+        return h
+    if arr.dtype.itemsize == 4:
+        bits = jax.lax.bitcast_convert_type(arr, jnp.uint32)
+    else:
+        bits = jax.lax.bitcast_convert_type(
+            arr.astype(jnp.float32), jnp.uint32
+        )
+    h = zlib.crc32(np.asarray(_leaf_checksum(bits)).tobytes(), h)
+    stride = max(1, n // _IDENT_SAMPLE)
+    sample = np.asarray(arr[::stride][:_IDENT_SAMPLE])
+    return zlib.crc32(np.ascontiguousarray(sample).tobytes(), h)
+
+
 def _run_identity(cfg, key, data, beta_init) -> np.ndarray:
     """Fingerprint of everything that determines the chain: the full
     config (its repr covers every field incl. priors), the fan-out
-    PRNG key, and the raw bytes of the data slices + warm start. A
-    checkpoint written under a different identity is rejected instead
-    of being silently resumed/returned (two runs differing only in
-    cov_model, key, or data have identical array shapes)."""
+    PRNG key, and shape/dtype + sampled bytes of the data slices +
+    warm start (see _leaf_fingerprint). A checkpoint written under a
+    different identity is rejected instead of being silently
+    resumed/returned (two runs differing only in cov_model, key, or
+    data have identical array shapes)."""
     crcs = [zlib.crc32(repr(cfg).encode())]
     crcs.append(zlib.crc32(_key_bytes(key)))
     for leaf in jax.tree_util.tree_leaves(data):
-        crcs.append(zlib.crc32(np.ascontiguousarray(leaf).tobytes()))
+        crcs.append(_leaf_fingerprint(leaf))
     if beta_init is not None:
-        crcs.append(
-            zlib.crc32(np.ascontiguousarray(beta_init).tobytes())
-        )
+        crcs.append(_leaf_fingerprint(beta_init))
     return np.asarray(crcs, np.uint32)
 
 
-def _init_states(model, keys, data, beta_init):
-    return jax.vmap(
-        lambda kk, d: model.init_state(kk, d, beta_init),
-        in_axes=(0, DATA_AXES),
-    )(keys, data)
+_init_states = init_subset_states  # backwards-compatible alias
 
 
 def _make_chunk_fn(model, kind, length, k, chunk_size):
-    """Compiled one-chunk program: vmap over the K axis, optionally
-    lax.map-chunked over K (``chunk_size`` bounds how many subsets are
-    resident at once — the same memory lever as fit_subsets_vmap), the
-    carried state donated (at north-star scale the duplicated carry
-    would OOM the chip)."""
+    """Compiled one-chunk program: vmap over the K axis (and, inside
+    each subset, over the chain axis when config.n_chains > 1),
+    optionally lax.map-chunked over K (``chunk_size`` bounds how many
+    subsets are resident at once — the same memory lever as
+    fit_subsets_vmap), the carried state donated (at north-star scale
+    the duplicated carry would OOM the chip)."""
     if kind == "burn":
-        body = lambda d, s, t: model.burn_chunk(d, s, t, length)
+        sub = lambda d, s, t: model.burn_chunk(d, s, t, length)
     else:
-        body = lambda d, s, t: model.sample_chunk(d, s, t, length)
+        sub = lambda d, s, t: model.sample_chunk(d, s, t, length)
+    if model.config.n_chains > 1:
+        body = lambda d, s, t: jax.vmap(
+            lambda ss: sub(d, ss, t)
+        )(s)
+    else:
+        body = sub
     runner = jax.vmap(body, in_axes=(DATA_AXES, 0, None))
     if chunk_size is None:
         return jax.jit(runner, donate_argnums=(1,))
@@ -205,7 +265,7 @@ def fit_subsets_chunked(
         raise ValueError(f"chunk_iters must be >= 1, got {chunk_iters}")
     k = part.n_subsets
     data = stacked_subset_data(part, coords_test, x_test)
-    keys = jax.random.split(key, k)
+    keys = subset_chain_keys(key, k, cfg.n_chains)
 
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -257,13 +317,15 @@ def fit_subsets_chunked(
     dtype = part.x.dtype
 
     def empty_draws():
+        lead = (k,) if cfg.n_chains == 1 else (k, cfg.n_chains)
         return (
-            jnp.zeros((k, 0, d_par), dtype),
-            jnp.zeros((k, 0, d_w), dtype),
+            jnp.zeros(lead + (0, d_par), dtype),
+            jnp.zeros(lead + (0, d_w), dtype),
         )
 
     meta = np.asarray(
-        [cfg.n_samples, cfg.n_burn_in, k, d_par, d_w], np.int64
+        [cfg.n_samples, cfg.n_burn_in, k, d_par, d_w, cfg.n_chains],
+        np.int64,
     )
     ident = _run_identity(cfg, key, data, beta_init)
     version = np.asarray([CKPT_VERSION], np.int64)
@@ -286,7 +348,8 @@ def fit_subsets_chunked(
             raise ValueError(
                 f"checkpoint {checkpoint_path} does not match the "
                 f"current checkpoint format v{CKPT_VERSION} (v2 added "
-                "run-identity stamping, v3 the iteration counter) — "
+                "run-identity stamping, v3 the iteration counter, v4 "
+                "the n_chains meta + sampled identity) — "
                 "it was written by an older build or for a different "
                 "run shape; delete the file or pass a fresh "
                 "checkpoint_path"
@@ -404,8 +467,10 @@ def fit_subsets_chunked(
         state, (pd, wd) = chunk_fn("samp", n)(
             data, state, jnp.asarray(it)
         )
-        param_draws = jnp.concatenate([param_draws, pd], axis=1)
-        w_draws = jnp.concatenate([w_draws, wd], axis=1)
+        # draws accumulate on the iteration axis — axis 1 for a single
+        # chain (K, it, d), axis 2 with chains (K, C, it, d)
+        param_draws = jnp.concatenate([param_draws, pd], axis=-2)
+        w_draws = jnp.concatenate([w_draws, wd], axis=-2)
         it += n
         guard()
         report("sample", n_burn)
@@ -481,7 +546,9 @@ def rerun_subsets(
     (the reference loses the entire job instead, SURVEY.md §5.3).
     """
     ids = jnp.asarray(subset_ids, jnp.int32)
-    keys = jax.random.split(key, part.n_subsets)[ids]
+    keys = subset_chain_keys(key, part.n_subsets, model.config.n_chains)[
+        ids
+    ]
     data = SubsetData(
         coords=part.coords[ids],
         x=part.x[ids],
@@ -491,9 +558,9 @@ def rerun_subsets(
         x_test=x_test,
     )
     init = _init_states(model, keys, data, beta_init)
-    rerun = jax.jit(jax.vmap(model.run, in_axes=(DATA_AXES, 0)))(
-        data, init
-    )
+    rerun = jax.jit(
+        jax.vmap(subset_runner(model), in_axes=(DATA_AXES, 0))
+    )(data, init)
     return jax.tree_util.tree_map(
         lambda full, new: jnp.asarray(full).at[ids].set(new),
         results,

@@ -1,12 +1,17 @@
 """Auxiliary subsystems: diagnostics, checkpointing, tracing
 (SURVEY.md §5 — everything the reference lacked)."""
 
-from smk_tpu.utils.diagnostics import effective_sample_size, split_rhat
+from smk_tpu.utils.diagnostics import (
+    effective_sample_size,
+    rhat,
+    split_rhat,
+)
 from smk_tpu.utils.checkpoint import save_pytree, load_pytree
 from smk_tpu.utils.tracing import phase_timer, PhaseTimes, device_sync
 
 __all__ = [
     "effective_sample_size",
+    "rhat",
     "split_rhat",
     "save_pytree",
     "load_pytree",

@@ -54,14 +54,34 @@ def effective_sample_size(chain: jnp.ndarray) -> jnp.ndarray:
     return out[0] if squeeze else out
 
 
-def split_rhat(chain: jnp.ndarray) -> jnp.ndarray:
-    """Split-R-hat per column of an (n, d) single chain (split in 2)."""
-    if chain.ndim == 1:
-        chain = chain[:, None]
-    n = chain.shape[0] // 2
-    halves = jnp.stack([chain[:n], chain[n : 2 * n]])  # (2, n, d)
+def rhat(chains: jnp.ndarray) -> jnp.ndarray:
+    """Split-R-hat over C parallel chains: (C, n, d) -> (d,).
+
+    Each chain is split in half (the standard split-R-hat guard
+    against within-chain trends), giving 2C sequences; R-hat is the
+    usual sqrt of (pooled variance estimate / within variance). With
+    C = 1 this is the single-chain split-R-hat the round-3 build
+    exposed; with the config's ``n_chains`` > 1 it is a true
+    cross-chain convergence diagnostic (SURVEY.md §5.5).
+    """
+    if chains.ndim == 2:
+        chains = chains[None]
+    c, n_full, d = chains.shape
+    n = n_full // 2
+    halves = jnp.concatenate(
+        [chains[:, :n], chains[:, n : 2 * n]]
+    )  # (2C, n, d)
     within = jnp.mean(jnp.var(halves, axis=1, ddof=1), axis=0)
     means = jnp.mean(halves, axis=1)
     between = n * jnp.var(means, axis=0, ddof=1)
     var_est = (n - 1) / n * within + between / n
     return jnp.sqrt(var_est / jnp.maximum(within, 1e-30))
+
+
+def split_rhat(chain: jnp.ndarray) -> jnp.ndarray:
+    """Split-R-hat per column of an (n, d) single chain (split in 2).
+
+    Kept as the single-chain convenience form of :func:`rhat`."""
+    if chain.ndim == 1:
+        chain = chain[:, None]
+    return rhat(chain[None])

@@ -124,8 +124,117 @@ class TestCsvLoader:
         path = str(tmp_path / "empty.csv")
         with open(path, "w") as f:
             f.write("latitude,longitude,effort_hrs,sp\n")
-        with pytest.raises(ValueError, match="no rows"):
+        with pytest.raises(ValueError, match="no usable rows"):
             load_presence_absence_csv(path, species_cols=["sp"])
+
+
+MESSY_CSV = """checklist_id,latitude,longitude,effort_hrs,sp1,sp2
+L001,40.10,-3.10,1.5,0,1
+L002,40.20,-3.20,2.0,X,0
+L003,40.30,-3.30,NA,1,0
+L002,40.20,-3.20,2.0,1,1
+L004,40.40,-3.40,0.5,3,0
+L005,,-3.50,1.0,0,0
+L006,40.60,-3.60,1.0,x,X
+L007,40.70,-3.70,abc,0,1
+"""
+
+
+class TestCsvLoaderRealWorldMess:
+    """VERDICT r3 #7: a messy real export (NA cells, duplicate
+    checklists, eBird 'X' detections, unparseable junk, missing
+    columns) must produce NAMED errors or documented drop policies —
+    never a bare float() traceback."""
+
+    def _write(self, tmp_path, text=MESSY_CSV, name="messy.csv"):
+        path = str(tmp_path / name)
+        with open(path, "w") as f:
+            f.write(text)
+        return path
+
+    def test_missing_columns_named_up_front(self, tmp_path):
+        path = self._write(tmp_path)
+        with pytest.raises(ValueError, match=r"missing column\(s\).*sp9"):
+            load_presence_absence_csv(path, species_cols=["sp1", "sp9"])
+        with pytest.raises(ValueError, match=r"missing column\(s\).*lat_wrong"):
+            load_presence_absence_csv(
+                path, species_cols=["sp1"], lat_col="lat_wrong"
+            )
+
+    def test_na_cell_error_names_row_and_column(self, tmp_path):
+        path = self._write(tmp_path)
+        # L003's effort is NA; the header is line 1 so L003 is row 4
+        with pytest.raises(ValueError, match="row 4.*'effort_hrs'.*missing"):
+            load_presence_absence_csv(path, species_cols=["sp1", "sp2"])
+
+    def test_unparseable_cell_names_row_and_column(self, tmp_path):
+        path = self._write(tmp_path)
+        # with NA rows dropped, the first hard error is L007's 'abc'
+        with pytest.raises(
+            ValueError, match="row 9.*'effort_hrs'.*cannot parse 'abc'"
+        ):
+            load_presence_absence_csv(
+                path, species_cols=["sp1", "sp2"], na_policy="drop",
+                max_rows=None, checklist_id_col="checklist_id",
+            )
+
+    def test_drop_policies_and_x_detections(self, tmp_path):
+        # remove the hard-error row; keep NA rows + the duplicate
+        text = "\n".join(
+            ln for ln in MESSY_CSV.splitlines() if "L007" not in ln
+        ) + "\n"
+        path = self._write(tmp_path, text)
+        data = load_presence_absence_csv(
+            path, species_cols=["sp1", "sp2"], na_policy="drop",
+            checklist_id_col="checklist_id",
+        )
+        # kept: L001, L002(first), L004, L006 — NA rows L003/L005
+        # dropped (counted), duplicate L002 dropped (counted)
+        assert data.y.shape == (4, 2)
+        assert data.n_dropped_na == 2
+        assert data.n_dropped_duplicates == 1
+        # eBird 'X'/'x' = presence; count 3 clamps to presence
+        np.testing.assert_array_equal(
+            data.y, [[0, 1], [1, 0], [1, 0], [1, 1]]
+        )
+
+    def test_negative_count_rejected(self, tmp_path):
+        path = self._write(
+            tmp_path,
+            "latitude,longitude,effort_hrs,sp\n40.0,-3.0,1.0,-2\n",
+        )
+        with pytest.raises(ValueError, match="row 2.*negative species"):
+            load_presence_absence_csv(path, species_cols=["sp"])
+
+    def test_nonfinite_value_rejected(self, tmp_path):
+        """R exports spell missing coordinates as Inf/-Inf sometimes;
+        float() parses them happily and the unit-square rescale then
+        NaNs every row — the loader must name the cell instead."""
+        path = self._write(
+            tmp_path,
+            "latitude,longitude,effort_hrs,sp\n-Inf,-3.0,1.0,1\n",
+        )
+        with pytest.raises(ValueError, match="row 2.*'latitude'.*non-finite"):
+            load_presence_absence_csv(path, species_cols=["sp"])
+
+    def test_blank_checklist_ids_never_dedupe(self, tmp_path):
+        """eBird's group_identifier is blank for every non-shared
+        checklist — blank ids identify nothing and must all be kept,
+        not collapsed onto the first blank row as 'duplicates'."""
+        path = self._write(
+            tmp_path,
+            "checklist_id,latitude,longitude,effort_hrs,sp\n"
+            "G001,40.1,-3.1,1.0,1\n"
+            ",40.2,-3.2,1.0,0\n"
+            ",40.3,-3.3,1.0,1\n"
+            "G001,40.1,-3.1,1.0,1\n"
+            ",40.4,-3.4,1.0,0\n",
+        )
+        data = load_presence_absence_csv(
+            path, species_cols=["sp"], checklist_id_col="checklist_id"
+        )
+        assert data.y.shape == (4, 1)  # 3 blank rows all kept
+        assert data.n_dropped_duplicates == 1  # only the real G001 dup
 
 
 class TestEndToEnd:

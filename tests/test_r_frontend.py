@@ -111,6 +111,12 @@ class TestRFrontendCallSequence:
             "w.quant": np.asarray(res.w_quant),
             "p.quant": np.asarray(res.p_quant),
             "phi.accept": np.asarray(res.phi_accept_rate),
+            # the r4 diagnostic surfacing (r/meta_kriging_tpu.R $ess /
+            # $rhat / $w.ess / $w.rhat)
+            "ess": np.asarray(res.param_ess),
+            "rhat": np.asarray(res.param_rhat),
+            "w.ess": np.asarray(res.w_ess),
+            "w.rhat": np.asarray(res.w_rhat),
         }
         assert out["result"].shape == (cfg.n_quantiles, d_par)
         assert out["result2"].shape == (cfg.n_quantiles, t * q)
@@ -121,6 +127,10 @@ class TestRFrontendCallSequence:
         assert out["w.quant"].shape == (3, t * q)
         assert out["p.quant"].shape == (3, t * q)
         assert out["phi.accept"].shape == (cfg.n_subsets, q)
+        assert out["ess"].shape == (cfg.n_subsets, d_par)
+        assert out["rhat"].shape == (cfg.n_subsets, d_par)
+        assert out["w.ess"].shape == (cfg.n_subsets, t * q)
+        assert out["w.rhat"].shape == (cfg.n_subsets, t * q)
         for name, arr in out.items():
             assert np.isfinite(arr).all(), f"{name} has non-finite values"
         assert ((out["p.sample"] >= 0) & (out["p.sample"] <= 1)).all()
@@ -229,6 +239,25 @@ class TestConfigOverrides:
         assert cfg.cg_iters == 8 and isinstance(cfg.cg_iters, int)
         with pytest.raises(ValueError, match="cg_iters"):
             smk.SMKConfig(cg_iters=8.5)
+        # numpy scalars (py_to_r edge paths) coerce like plain floats
+        assert smk.SMKConfig(cg_iters=np.float64(8.0)).cg_iters == 8
+        assert smk.SMKConfig(cg_iters=np.int64(8)).cg_iters == 8
+
+    def test_integer_fields_reject_bool_and_strings(self):
+        """ADVICE r3: bool passes isinstance(v, int) so cg_iters=True
+        silently became 1, and numeric strings like '8' were coerced
+        via float(); both must be rejected (a string reaching a shape
+        is always a caller bug, and True-as-1 is never intended)."""
+        import smk_tpu as smk
+
+        with pytest.raises(ValueError, match="cg_iters"):
+            smk.SMKConfig(cg_iters=True)
+        with pytest.raises(ValueError, match="n_samples"):
+            smk.SMKConfig(n_samples=False)
+        with pytest.raises(ValueError, match="cg_iters"):
+            smk.SMKConfig(cg_iters="8")
+        with pytest.raises(ValueError, match="n_samples"):
+            smk.SMKConfig(n_samples=float("inf"))
 
 
 class TestInputShapeValidation:

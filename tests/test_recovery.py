@@ -121,6 +121,28 @@ class TestCheckpointedFit:
                 checkpoint_path=path, chunk_iters=10,
             )
 
+    def test_single_offgrid_data_change_rejected(self, problem, tmp_path):
+        """The v4 identity is SAMPLED (no full-array host fetch), but
+        its on-device XOR/sum checksum still covers every element: a
+        single changed value that the strided sample would miss must
+        flip the fingerprint (code-review r4: the pure-sample scheme
+        silently resumed onto changed data)."""
+        model, part, ct, xt, key = problem
+        path = os.path.join(tmp_path, "offgrid.npz")
+        fit_subsets_checkpointed(
+            model, part, ct, xt, key,
+            checkpoint_path=path, chunk_iters=10, stop_after_chunks=1,
+        )
+        # mutate ONE coordinate at an index off any small stride grid
+        coords = np.asarray(part.coords).copy()
+        coords[1, 3, 0] += 1e-3
+        part_mut = part._replace(coords=jnp.asarray(coords))
+        with pytest.raises(ValueError, match="fingerprint mismatch"):
+            fit_subsets_checkpointed(
+                model, part_mut, ct, xt, key,
+                checkpoint_path=path, chunk_iters=10,
+            )
+
     def test_bad_chunk_iters_rejected(self, problem, tmp_path):
         model, part, ct, xt, key = problem
         with pytest.raises(ValueError, match="chunk_iters"):
