@@ -45,6 +45,8 @@
 #                converged (the reference offered only acceptance
 #                printouts + traceplots, R:84,148-149)
 #   $w.ess / $w.rhat  the same per predicted latent (K x t*q)
+#   $ess.per.sec total latent ESS / subset-fit seconds (the headline
+#                sampling-efficiency number)
 #   $phases      wall-clock per pipeline phase
 
 meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
@@ -157,6 +159,7 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
     rhat = to_r(res$param_rhat),
     w.ess = to_r(res$w_ess),
     w.rhat = to_r(res$w_rhat),
+    ess.per.sec = res$latent_ess_per_sec,
     phases = res$phase_seconds,
     param.names = unlist(smk$api$param_names(as.integer(q), as.integer(p)))
   )

@@ -32,7 +32,6 @@ import jax
 # same) and BEFORE jax.distributed.initialize touches the backend
 jax.config.update("jax_platforms", "cpu")
 
-import jax.numpy as jnp
 import numpy as np
 
 
@@ -48,23 +47,17 @@ def main():
     )
 
     from smk_tpu.config import SMKConfig
+    from smk_tpu.data.synthetic import tiny_binary_problem
     from smk_tpu.models.probit_gp import SpatialGPSampler
     from smk_tpu.parallel.combine import combine_quantile_grids
     from smk_tpu.parallel.executor import fit_subsets_sharded, make_mesh
     from smk_tpu.parallel.partition import random_partition
 
     # identical problem on every process (global-array semantics need
-    # consistent host inputs) — same generator as the test's reference
-    key = jax.random.key(0)
-    n, q, p, t, k = 240, 1, 2, 6, 4
-    kc, kx, ky, kt = jax.random.split(key, 4)
-    coords = jax.random.uniform(kc, (n, 2))
-    x = jnp.concatenate(
-        [jnp.ones((n, q, 1)), jax.random.normal(kx, (n, q, p - 1))], -1
-    )
-    y = (jax.random.uniform(ky, (n, q)) < 0.5).astype(jnp.float32)
-    coords_test = jax.random.uniform(kt, (t, 2))
-    x_test = jnp.ones((t, q, p))
+    # consistent host inputs) — the SHARED generator the test's
+    # single-process reference also builds from
+    k = 4
+    y, x, coords, coords_test, x_test = tiny_binary_problem()
 
     cfg = SMKConfig(
         n_subsets=k, n_samples=40, u_solver="cg", cg_iters=16,

@@ -1,0 +1,71 @@
+"""Quick measured ms/iter probe of the north-star chunk program.
+
+Compiles the production burn-chunk at the config-5 slice (m=3906,
+K=32) under the CURRENT bench solver env (BENCH_* overrides apply,
+e.g. BENCH_PHI_EVERY) and times a few chunks — the fast way to read
+the effect of one solver knob without paying for a full bench ladder.
+
+Run on TPU:  BENCH_PHI_EVERY=8 python scripts/rate_probe.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from scripts._slice_harness import (
+    bench_solver_config,
+    build_chunk_program,
+    make_slice_data,
+    real_init_states,
+)
+from smk_tpu.utils.tracing import device_sync
+
+M = int(os.environ.get("PROBE_M", 3906))
+K = int(os.environ.get("PROBE_K", 32))
+CHUNK = int(os.environ.get("PROBE_CHUNK", 100))
+N_CHUNKS = int(os.environ.get("PROBE_CHUNKS", 3))
+
+
+def main():
+    import dataclasses
+
+    data = make_slice_data(M, K, 1, 64)
+    cfg = bench_solver_config(K)
+    over = {}
+    if "BENCH_PHI_EVERY" in os.environ:
+        over["phi_update_every"] = int(os.environ["BENCH_PHI_EVERY"])
+    if "BENCH_CG_ITERS" in os.environ:
+        over["cg_iters"] = int(os.environ["BENCH_CG_ITERS"])
+    cfg = dataclasses.replace(cfg, **over)
+    t0 = time.time()
+    model, compiled = build_chunk_program(cfg, data, CHUNK, K)
+    compile_s = time.time() - t0
+    state = real_init_states(model, data, K)
+    device_sync(state.beta)
+    rates = []
+    it = 0
+    for _ in range(N_CHUNKS):
+        tc = time.time()
+        state = compiled(data, state, jnp.asarray(it))
+        device_sync(state.beta)
+        it += CHUNK
+        rates.append((time.time() - tc) / CHUNK * 1e3)
+    print(json.dumps({
+        "m": M, "K": K, "chunk": CHUNK,
+        "phi_update_every": cfg.phi_update_every,
+        "cg_iters": cfg.cg_iters,
+        "compile_s": round(compile_s, 1),
+        "ms_per_iter": [round(r, 2) for r in rates],
+        "best_ms_per_iter": round(min(rates), 2),
+        "est_config5_fit_s": round(min(rates) * 5.0, 1),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -57,6 +57,10 @@ class MetaKrigingResult(NamedTuple):
         eyeballed traceplots, R:148-149). Columns follow
         ``param_names(q, p)``.
     w_ess / w_rhat : (K, t*q) the same per predicted latent.
+    latent_ess_per_sec : total predicted-latent ESS divided by the
+        subset-fit wall-clock — the BASELINE.json headline efficiency
+        metric, computed on every run (SURVEY.md §5.5 "ESS/sec ...
+        first-class output").
     phase_seconds : structured wall-clock per phase (replaces
         R:30,106,111).
     """
@@ -75,6 +79,7 @@ class MetaKrigingResult(NamedTuple):
     param_rhat: jnp.ndarray
     w_ess: jnp.ndarray
     w_rhat: jnp.ndarray
+    latent_ess_per_sec: float
     phase_seconds: dict
 
 
@@ -313,5 +318,9 @@ def fit_meta_kriging(
         param_rhat=results.param_rhat,
         w_ess=results.w_ess,
         w_rhat=results.w_rhat,
+        latent_ess_per_sec=float(
+            jnp.sum(jnp.nan_to_num(results.w_ess, nan=0.0))
+            / max(times.as_dict().get("subset_fits", 0.0), 1e-9)
+        ),
         phase_seconds=times.as_dict(),
     )

@@ -163,7 +163,10 @@ def load_presence_absence_csv(
                 f"{header}"
             )
         for i, row in enumerate(reader):
-            if max_rows is not None and len(lat) >= max_rows:
+            # max_rows bounds rows SCANNED (not kept): on a
+            # drop-heavy multi-million-row export, a kept-rows cap
+            # would silently read to EOF
+            if max_rows is not None and i >= max_rows:
                 break
             row_num = i + 2  # 1-based, counting the header line
             cid = None

@@ -1,0 +1,38 @@
+"""Small shared synthetic problems.
+
+``tiny_binary_problem`` is the one fixed-seed toy problem used by the
+cross-process DCN worker (scripts/_dcn_worker.py), its in-test
+single-process reference (tests/test_distributed.py) and the
+chains/diagnostics test fixture — those callers must all build the
+byte-identical dataset (the two-process test compares posteriors
+across processes), so the construction lives here once. The
+bench-scale generator is ``bench.make_binary_field`` (RFF-based, O(n));
+this one is deliberately tiny and dependency-free.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def tiny_binary_problem(
+    seed: int = 0, n: int = 240, q: int = 1, p: int = 2, t: int = 6
+):
+    """(y, x, coords, coords_test, x_test) for a tiny binary fit.
+
+    Deterministic in ``seed``; y is Bernoulli(0.5) noise — these
+    problems exercise plumbing (executors, chains, distribution), not
+    statistical recovery (tests/test_sampler.py's synthetic_subset
+    builds real LMC fields for that).
+    """
+    key = jax.random.key(seed)
+    kc, kx, ky, kt = jax.random.split(key, 4)
+    coords = jax.random.uniform(kc, (n, 2))
+    x = jnp.concatenate(
+        [jnp.ones((n, q, 1)), jax.random.normal(kx, (n, q, p - 1))], -1
+    )
+    y = (jax.random.uniform(ky, (n, q)) < 0.5).astype(jnp.float32)
+    coords_test = jax.random.uniform(kt, (t, 2))
+    x_test = jnp.ones((t, q, p))
+    return y, x, coords, coords_test, x_test

@@ -352,7 +352,12 @@ class SpatialGPSampler:
         hi = jnp.asarray(cfg.priors.phi_max, dtype)
 
         def u_loglik(chol_r):
-            # (q, m, m) stacked factors vs (m, q) components
+            # (q, m, m) stacked factors vs (m, q) components. NOTE:
+            # batching the proposal+current pair into one (2q, m, m)
+            # trisolve was tried in r4 and REVERTED — the concat
+            # materializes a second copy of both factors (~3.9 GB at
+            # the north-star slice) and pushes the chip 186 MB over
+            # HBM; two separate solves reuse the existing buffers.
             alpha = jax.vmap(tri_solve)(chol_r, u.T[..., None])[..., 0]
             return -0.5 * jnp.sum(alpha * alpha, axis=-1) - 0.5 * chol_logdet(
                 chol_r

@@ -124,13 +124,13 @@ def _leaf_fingerprint(leaf) -> int:
 
     The v3 scheme CRC'd every byte of every partitioned leaf — at
     north-star scale a multi-GB device->host fetch before the first
-    chunk of every checkpointed run. Here the whole-array work (a
-    bitwise XOR-reduce and a mod-2^32 sum of element bit patterns)
-    runs on device, so EVERY element participates — a single changed
-    row anywhere flips the checksum — while only 2 scalars plus a
-    <= _IDENT_SAMPLE-element strided sample (which pins down WHERE
-    values live, catching e.g. swapped leaves with equal multisets)
-    cross to host."""
+    chunk of every checkpointed run. Here the whole-array work (the
+    plain and position-weighted mod-2^32 sums of element bit patterns
+    — see _leaf_checksum) runs on device, so EVERY element
+    participates — a single changed element anywhere moves the plain
+    sum, and reorderings move the weighted one — while only 2 scalars
+    plus a <= _IDENT_SAMPLE-element strided sample (which pins down
+    WHERE values live) cross to host."""
     arr = jnp.asarray(leaf).reshape(-1)
     n = int(arr.shape[0])
     h = zlib.crc32(repr((jnp.shape(leaf), str(arr.dtype))).encode())

@@ -63,6 +63,10 @@ def rhat(chains: jnp.ndarray) -> jnp.ndarray:
     C = 1 this is the single-chain split-R-hat the round-3 build
     exposed; with the config's ``n_chains`` > 1 it is a true
     cross-chain convergence diagnostic (SURVEY.md §5.5).
+
+    Needs n >= 4 draws per chain: halves shorter than 2 make the
+    ddof=1 within-chain variance undefined and the result is NaN
+    (deliberately — a 2-draw "diagnostic" would be noise).
     """
     if chains.ndim == 2:
         chains = chains[None]

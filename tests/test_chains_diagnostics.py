@@ -29,16 +29,12 @@ from smk_tpu.utils.diagnostics import effective_sample_size, rhat
 
 @pytest.fixture(scope="module")
 def small_problem():
-    key = jax.random.key(0)
+    from smk_tpu.data.synthetic import tiny_binary_problem
+
     n, q, p, t, k = 240, 1, 2, 6, 4
-    kc, kx, ky, kt = jax.random.split(key, 4)
-    coords = jax.random.uniform(kc, (n, 2))
-    x = jnp.concatenate(
-        [jnp.ones((n, q, 1)), jax.random.normal(kx, (n, q, p - 1))], -1
+    y, x, coords, coords_test, x_test = tiny_binary_problem(
+        n=n, q=q, p=p, t=t
     )
-    y = (jax.random.uniform(ky, (n, q)) < 0.5).astype(jnp.float32)
-    coords_test = jax.random.uniform(kt, (t, 2))
-    x_test = jnp.ones((t, q, p))
     part = random_partition(jax.random.key(1), y, x, coords, k)
     return part, coords_test, x_test, (n, q, p, t, k)
 
@@ -128,6 +124,9 @@ class TestDiagnosticFieldsSingleChain:
         assert res.param_rhat.shape == (k, d)
         assert res.w_ess.shape == (k, t * q)
         assert res.w_rhat.shape == (k, t * q)
+        # ESS/sec is a first-class output (SURVEY.md §5.5); the fit
+        # took nonzero wall-clock and produced positive latent ESS
+        assert res.latent_ess_per_sec > 0
 
 
 class TestMultiChain:

@@ -39,24 +39,20 @@ def _free_port():
 
 
 def _single_process_reference():
-    """The same problem as scripts/_dcn_worker.py, on this process's
-    CPU backend (vmap path — sharded==vmap is separately asserted)."""
+    """The same problem as scripts/_dcn_worker.py — built from the
+    SHARED generator (smk_tpu.data.synthetic.tiny_binary_problem) so
+    the cross-process comparison can never silently drift — on this
+    process's CPU backend (vmap path; sharded==vmap is separately
+    asserted)."""
     from smk_tpu.config import SMKConfig
+    from smk_tpu.data.synthetic import tiny_binary_problem
     from smk_tpu.models.probit_gp import SpatialGPSampler
     from smk_tpu.parallel.combine import combine_quantile_grids
     from smk_tpu.parallel.executor import fit_subsets_vmap
     from smk_tpu.parallel.partition import random_partition
 
-    key = jax.random.key(0)
-    n, q, p, t, k = 240, 1, 2, 6, 4
-    kc, kx, ky, kt = jax.random.split(key, 4)
-    coords = jax.random.uniform(kc, (n, 2))
-    x = jnp.concatenate(
-        [jnp.ones((n, q, 1)), jax.random.normal(kx, (n, q, p - 1))], -1
-    )
-    y = (jax.random.uniform(ky, (n, q)) < 0.5).astype(jnp.float32)
-    coords_test = jax.random.uniform(kt, (t, 2))
-    x_test = jnp.ones((t, q, p))
+    k = 4
+    y, x, coords, coords_test, x_test = tiny_binary_problem()
     cfg = SMKConfig(
         n_subsets=k, n_samples=40, u_solver="cg", cg_iters=16,
         phi_update_every=2, n_quantiles=20,
