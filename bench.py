@@ -47,7 +47,7 @@ config2 elsewhere), BENCH_BUDGET_S (default 1450 — the driver kills
 at ~1800 s; leave headroom for interpreter + data-gen + compiles),
 BENCH_SAMPLES / BENCH_CG_ITERS / BENCH_CG_PRECOND / BENCH_CG_RANK /
 BENCH_CG_DTYPE / BENCH_PHI_EVERY / BENCH_USOLVER / BENCH_CHUNK_ITERS /
-BENCH_CHOL_BLOCK / BENCH_A_PRIOR
+BENCH_CHOL_BLOCK / BENCH_A_PRIOR / BENCH_TEMPER
 override the solver settings (defaults below are the validated
 scaling-regime configuration).
 
@@ -321,8 +321,13 @@ def run_rung(name, *, n, k, cov_model, n_samples, q=1, p=2, n_test=64,
         chol_block_size=int(env.get("BENCH_CHOL_BLOCK", 0)),
         # the reference's own K-prior (R:64): IW shrinkage keeps the
         # latent scale identified over the full 5000-iteration budget
-        # on purely binary responses (see PriorConfig docstring)
-        priors=PriorConfig(a_prior=env.get("BENCH_A_PRIOR", "invwishart")),
+        # on purely binary responses (see PriorConfig docstring).
+        # BENCH_TEMPER=power runs the r4 tempered-prior option (the
+        # default stays reference-faithful).
+        priors=PriorConfig(
+            a_prior=env.get("BENCH_A_PRIOR", "invwishart"),
+            temper=env.get("BENCH_TEMPER", "none"),
+        ),
     )
     model = SpatialGPSampler(cfg, weight=1)
     part = random_partition(jax.random.key(1), y, x, coords, k)

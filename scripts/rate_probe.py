@@ -37,11 +37,22 @@ def main():
 
     data = make_slice_data(M, K, 1, 64)
     cfg = bench_solver_config(K)
-    over = {}
-    if "BENCH_PHI_EVERY" in os.environ:
-        over["phi_update_every"] = int(os.environ["BENCH_PHI_EVERY"])
-    if "BENCH_CG_ITERS" in os.environ:
-        over["cg_iters"] = int(os.environ["BENCH_CG_ITERS"])
+    # the same BENCH_* -> SMKConfig field map bench.py's run_rung
+    # applies, so a probed knob is really the knob that ran
+    env_fields = {
+        "BENCH_PHI_EVERY": ("phi_update_every", int),
+        "BENCH_CG_ITERS": ("cg_iters", int),
+        "BENCH_CG_PRECOND": ("cg_precond", str),
+        "BENCH_CG_RANK": ("cg_precond_rank", int),
+        "BENCH_CG_DTYPE": ("cg_matvec_dtype", str),
+        "BENCH_USOLVER": ("u_solver", str),
+        "BENCH_CHOL_BLOCK": ("chol_block_size", int),
+    }
+    over = {
+        field: conv(os.environ[name])
+        for name, (field, conv) in env_fields.items()
+        if name in os.environ
+    }
     cfg = dataclasses.replace(cfg, **over)
     t0 = time.time()
     model, compiled = build_chunk_program(cfg, data, CHUNK, K)
@@ -58,8 +69,8 @@ def main():
         rates.append((time.time() - tc) / CHUNK * 1e3)
     print(json.dumps({
         "m": M, "K": K, "chunk": CHUNK,
-        "phi_update_every": cfg.phi_update_every,
-        "cg_iters": cfg.cg_iters,
+        **{field: getattr(cfg, field)
+           for field, _ in env_fields.values()},
         "compile_s": round(compile_s, 1),
         "ms_per_iter": [round(r, 2) for r in rates],
         "best_ms_per_iter": round(min(rates), 2),

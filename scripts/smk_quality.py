@@ -8,8 +8,16 @@ scale where the full-data fit is still tractable on one chip —
 n=4000: a K=8 meta fit vs the K=1 full fit, identical model, solver,
 and MCMC budget, both through the public fit_meta_kriging pipeline.
 
-Reported per parameter (beta, K00, phi):
-  - posterior medians of both fits, gap in FULL-posterior sd units
+Three arms since r4: the full K=1 fit, the meta fit, and the meta fit
+under the tempered prior (PriorConfig(temper="power") — each subset
+prior raised to the 1/K power, VERDICT r3 #4).
+
+Reported per parameter (beta, K00, phi), for both meta arms:
+  - posterior medians of all fits; gaps in FULL-posterior sd units
+    (transparency) AND in META-posterior sd units (calibration — "is
+    the full answer inside the approximate posterior's own
+    uncertainty"; full-sd units inflate fixed absolute error as the
+    full posterior tightens ~1/sqrt(n))
   - the W2 distance between the 200-point quantile grids relative to
     the full posterior sd (the combiner's own geometry)
 plus the same W2 summary for the predicted latent surface at the
@@ -26,14 +34,17 @@ published (the reference's per-subset spBayes priors behave
 identically, R:63-64), not an implementation artifact. Meanwhile the
 full posterior's sd shrinks ~1/sqrt(n), so gaps MEASURED IN FULL-SD
 UNITS grow with n even at fixed absolute accuracy. The pass criterion
-therefore scores what the method promises: slope recovery and the
-latent predictive surface; the K/phi rows are reported for
-transparency.
+therefore scores what the method promises — slope recovery (in the
+stable meta-sd calibration units) and the latent predictive surface —
+while the K/phi rows are reported for transparency; the tempered arm
+carries its own criterion (the K artifact is fixable by tempering,
+phi's subset-information gap is not — a flat prior has no mass to
+temper).
 
 Run on TPU (prints one JSON line to stdout; one line per QUAL_N):
-    python scripts/smk_quality.py >  SMK_QUALITY_r03.jsonl
-    QUAL_N=8000 python scripts/smk_quality.py >> SMK_QUALITY_r03.jsonl
-Commit SMK_QUALITY_r03.jsonl (the name BASELINE.md cites).
+    python scripts/smk_quality.py >  SMK_QUALITY_r04.jsonl
+    QUAL_N=8000 python scripts/smk_quality.py >> SMK_QUALITY_r04.jsonl
+Commit SMK_QUALITY_r04.jsonl (the name BASELINE.md cites).
 """
 
 import json

@@ -143,6 +143,11 @@ def load_presence_absence_csv(
       first occurrence of each id and count the rest in
       ``n_dropped_duplicates`` (eBird shared checklists appear once
       per observer — without an id column every row is kept).
+
+    ``max_rows`` bounds CSV rows SCANNED (header excluded), not rows
+    kept: on a drop-heavy multi-million-row export a kept-rows cap
+    would silently read to end of file, so with drop policies active
+    the returned dataset can hold fewer than ``max_rows`` rows.
     """
     if na_policy not in ("error", "drop"):
         raise ValueError("na_policy must be 'error' or 'drop'")

@@ -198,6 +198,22 @@ class TestCsvLoaderRealWorldMess:
             data.y, [[0, 1], [1, 0], [1, 0], [1, 1]]
         )
 
+    def test_max_rows_bounds_scanned_not_kept(self, tmp_path):
+        """max_rows caps rows SCANNED: with NA drops active, fewer
+        rows come back (the cap must never turn into a full-file
+        read on drop-heavy exports)."""
+        text = "latitude,longitude,effort_hrs,sp\n" + "".join(
+            (f"40.{i},-3.0,NA,1\n" if i % 2 == 0 else f"40.{i},-3.0,1.0,1\n")
+            for i in range(10)
+        )
+        path = self._write(tmp_path, text)
+        data = load_presence_absence_csv(
+            path, species_cols=["sp"], na_policy="drop", max_rows=6
+        )
+        # rows 0..5 scanned: 3 NA-dropped, 3 kept
+        assert data.y.shape[0] == 3
+        assert data.n_dropped_na == 3
+
     def test_negative_count_rejected(self, tmp_path):
         path = self._write(
             tmp_path,
