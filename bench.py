@@ -47,7 +47,7 @@ config2 elsewhere), BENCH_BUDGET_S (default 1450 — the driver kills
 at ~1800 s; leave headroom for interpreter + data-gen + compiles),
 BENCH_SAMPLES / BENCH_CG_ITERS / BENCH_CG_PRECOND / BENCH_CG_RANK /
 BENCH_CG_DTYPE / BENCH_PHI_EVERY / BENCH_USOLVER / BENCH_CHUNK_ITERS /
-BENCH_CHOL_BLOCK / BENCH_A_PRIOR / BENCH_TEMPER
+BENCH_CHOL_BLOCK / BENCH_TRI_BLOCK / BENCH_A_PRIOR / BENCH_TEMPER
 override the solver settings (defaults below are the validated
 scaling-regime configuration).
 
@@ -319,6 +319,10 @@ def run_rung(name, *, n, k, cov_model, n_samples, q=1, p=2, n_test=64,
         cg_matvec_dtype=env.get("BENCH_CG_DTYPE", "bfloat16"),
         phi_update_every=int(env.get("BENCH_PHI_EVERY", 4)),
         chol_block_size=int(env.get("BENCH_CHOL_BLOCK", 0)),
+        # blocked-GEMM trisolves with carried panel inverses: XLA's
+        # native trisolve is latency-bound at these shapes (measured
+        # 2x, ops/chol.py blocked_tri_solve)
+        trisolve_block_size=int(env.get("BENCH_TRI_BLOCK", 512)),
         # the reference's own K-prior (R:64): IW shrinkage keeps the
         # latent scale identified over the full 5000-iteration budget
         # on purely binary responses (see PriorConfig docstring).

@@ -46,6 +46,7 @@ def bench_solver_config(k):
         cg_precond_rank=256,
         cg_matvec_dtype="bfloat16",
         phi_update_every=4,
+        trisolve_block_size=512,
         priors=PriorConfig(a_prior="invwishart"),
     )
 

@@ -1,9 +1,15 @@
 """Quick measured ms/iter probe of the north-star chunk program.
 
-Compiles the production burn-chunk at the config-5 slice (m=3906,
-K=32) under the CURRENT bench solver env (BENCH_* overrides apply,
-e.g. BENCH_PHI_EVERY) and times a few chunks — the fast way to read
-the effect of one solver knob without paying for a full bench ladder.
+Compiles the production chunk at the config-5 slice (m=3906, K=32)
+under the CURRENT bench solver env (BENCH_* overrides apply, e.g.
+BENCH_PHI_EVERY) and times a few chunks — the fast way to read the
+effect of one solver knob without paying for a full bench ladder.
+
+PROBE_KIND=burn (default) times the burn-in scan; PROBE_KIND=samp
+times the COLLECTING scan (adds the per-kept-draw predictive kriging
+— the spPredict-equivalent composition sampling — and the draw
+outputs), so the burn-vs-samp difference is the measured cost of the
+collection path at PROBE_T test sites.
 
 Run on TPU:  BENCH_PHI_EVERY=8 python scripts/rate_probe.py
 """
@@ -28,14 +34,16 @@ from smk_tpu.utils.tracing import device_sync
 
 M = int(os.environ.get("PROBE_M", 3906))
 K = int(os.environ.get("PROBE_K", 32))
+T = int(os.environ.get("PROBE_T", 64))
 CHUNK = int(os.environ.get("PROBE_CHUNK", 100))
 N_CHUNKS = int(os.environ.get("PROBE_CHUNKS", 3))
+KIND = os.environ.get("PROBE_KIND", "burn")
 
 
 def main():
     import dataclasses
 
-    data = make_slice_data(M, K, 1, 64)
+    data = make_slice_data(M, K, 1, T)
     cfg = bench_solver_config(K)
     # the same BENCH_* -> SMKConfig field map bench.py's run_rung
     # applies, so a probed knob is really the knob that ran
@@ -47,6 +55,7 @@ def main():
         "BENCH_CG_DTYPE": ("cg_matvec_dtype", str),
         "BENCH_USOLVER": ("u_solver", str),
         "BENCH_CHOL_BLOCK": ("chol_block_size", int),
+        "BENCH_TRI_BLOCK": ("trisolve_block_size", int),
     }
     over = {
         field: conv(os.environ[name])
@@ -54,21 +63,45 @@ def main():
         if name in os.environ
     }
     cfg = dataclasses.replace(cfg, **over)
-    t0 = time.time()
-    model, compiled = build_chunk_program(cfg, data, CHUNK, K)
-    compile_s = time.time() - t0
-    state = real_init_states(model, data, K)
-    device_sync(state.beta)
+    if KIND == "burn":
+        t0 = time.time()
+        model, compiled = build_chunk_program(cfg, data, CHUNK, K)
+        compile_s = time.time() - t0
+        state = real_init_states(model, data, K)
+        device_sync(state.beta)
+    else:  # the collecting scan: kriging + draw outputs included
+        from smk_tpu.models.probit_gp import SpatialGPSampler
+        from smk_tpu.parallel.executor import DATA_AXES
+
+        model = SpatialGPSampler(cfg, weight=1)
+        state = real_init_states(model, data, K)
+        device_sync(state.beta)
+        fn = jax.jit(
+            jax.vmap(
+                lambda d, s, t: model.sample_chunk(d, s, t, CHUNK),
+                in_axes=(DATA_AXES, 0, None),
+            ),
+            donate_argnums=(1,),
+        )
+        # AOT-compile so the first timed chunk measures execution,
+        # not trace+compile (the burn path's build_chunk_program
+        # does the same)
+        t0 = time.time()
+        compiled = fn.lower(data, state, jnp.asarray(0)).compile()
+        compile_s = time.time() - t0
     rates = []
     it = 0
     for _ in range(N_CHUNKS):
         tc = time.time()
-        state = compiled(data, state, jnp.asarray(it))
+        if KIND == "burn":
+            state = compiled(data, state, jnp.asarray(it))
+        else:
+            state, (pd, wd) = compiled(data, state, jnp.asarray(it))
         device_sync(state.beta)
         it += CHUNK
         rates.append((time.time() - tc) / CHUNK * 1e3)
     print(json.dumps({
-        "m": M, "K": K, "chunk": CHUNK,
+        "m": M, "K": K, "t": T, "kind": KIND, "chunk": CHUNK,
         **{field: getattr(cfg, field)
            for field, _ in env_fields.values()},
         "compile_s": round(compile_s, 1),

@@ -185,6 +185,20 @@ class SMKConfig:
     # panel-bound.
     chol_block_size: int = 0
 
+    # Blocked-GEMM triangular solves for the m-sized solves against
+    # the carried factor (the phi-MH log-likelihood and the
+    # predictive-kriging conditionals): 0 = XLA's native trisolve;
+    # > 0 = ops/chol.py blocked_tri_solve with this panel size.
+    # Unlike the Cholesky, the native TRISOLVE at these shapes is
+    # badly latency-bound on v5e — measured in-scan at
+    # (32, 3906, 3906): 30.4 -> 15.6 ms (64 rhs) and 28.5 -> 12.4 ms
+    # (1 rhs) at panel 512 — and the diagonal-panel inverses are
+    # carried in the SolveCache (phi-only), amortizing their build to
+    # one per accepted phi move. Same math to fp32 reassociation
+    # (tests/test_ops.py). 0 stays the default for the
+    # reference-faithful small-m path; the bench sets 512.
+    trisolve_block_size: int = 0
+
     # Pólya-Gamma series truncation for the logit link: omega is drawn
     # from the defining infinite series cut at this many terms with
     # the dropped tail replaced by its mean, so the logit chain
@@ -230,7 +244,8 @@ class SMKConfig:
     _INT_FIELDS = (
         "n_subsets", "n_samples", "n_chains", "n_quantiles",
         "resample_size", "weiszfeld_iters", "phi_update_every",
-        "cg_iters", "cg_precond_rank", "chol_block_size", "pg_n_terms",
+        "cg_iters", "cg_precond_rank", "chol_block_size",
+        "trisolve_block_size", "pg_n_terms",
     )
 
     def __post_init__(self):
@@ -291,6 +306,10 @@ class SMKConfig:
             )
         if self.chol_block_size < 0:
             raise ValueError("chol_block_size must be >= 0 (0 = XLA)")
+        if self.trisolve_block_size < 0:
+            raise ValueError(
+                "trisolve_block_size must be >= 0 (0 = XLA native)"
+            )
         if self.phi_update_every < 1:
             raise ValueError("phi_update_every must be >= 1")
         if self.n_chains < 1:

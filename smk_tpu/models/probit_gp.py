@@ -63,9 +63,11 @@ from jax import lax
 from smk_tpu.config import SMKConfig
 from smk_tpu.ops.chol import (
     blocked_cholesky,
+    blocked_tri_solve,
     chol_logdet,
     chol_solve,
     jittered_cholesky,
+    panel_inverses,
     tri_solve,
 )
 from smk_tpu.ops.cg import (
@@ -129,17 +131,26 @@ class SolveCache(NamedTuple):
     carry NEXT TO SamplerState — not inside it, keeping the checkpoint
     format untouched — and are refreshed only inside the phi-MH branch
     on acceptance (where the proposal's correlation is built anyway).
-    Chunk boundaries rebuild the cache from state.phi, which is
-    deterministic and therefore bit-exact under any chunking.
+    Chunk boundaries rebuild the cache from the carried state —
+    r_mv/nys_z from state.phi, chol_inv from state.chol_r — all
+    deterministic functions of checkpointed values, so chunking and
+    kill/resume stay bit-exact.
 
     r_mv:  (q, m, m) masked correlation in the CG matvec dtype
-           (bfloat16 at bench scale — half the HBM stream).
+           (bfloat16 at bench scale — half the HBM stream); None when
+           u_solver != "cg".
     nys_z: (q, m, rank) Nystrom factor Z (ops/cg.py nystrom_factor),
            or None when cg_precond != "nystrom".
+    chol_inv: (q, nb, p, p) diagonal-panel inverses of the carried
+           chol_r for the blocked triangular solves (the phi-MH
+           log-likelihood and kriging conditionals — ops/chol.py
+           blocked_tri_solve); None when trisolve_block_size == 0 or
+           m is too small for the blocked solve to engage.
     """
 
-    r_mv: jnp.ndarray
+    r_mv: Optional[jnp.ndarray]
     nys_z: Optional[jnp.ndarray]
+    chol_inv: Optional[jnp.ndarray]
 
 
 class SubsetResult(NamedTuple):
@@ -217,8 +228,8 @@ class SpatialGPSampler:
             else dtype
         )
 
-    def _cache_from_r(self, r_full: jnp.ndarray) -> SolveCache:
-        """Build the carried solve operators from a freshly built
+    def _r_operators(self, r_full: jnp.ndarray):
+        """(r_mv, nys_z) carried CG operators from a freshly built
         (q, m, m) masked correlation (full precision)."""
         cfg = self.config
         m = r_full.shape[-1]
@@ -230,20 +241,50 @@ class SpatialGPSampler:
             )
         else:
             nys_z = None
-        return SolveCache(r_mv=r_mv, nys_z=nys_z)
+        return r_mv, nys_z
 
-    def _solve_cache(self, dist, mask, phi) -> Optional[SolveCache]:
-        """Cache for the current phi — the scan-entry (and chunk-
-        boundary) build; deterministic in phi, so rebuilding here is
-        bit-identical to the carried value."""
+    def _use_blocked_tri(self, m: int) -> bool:
+        """Whether the blocked trisolve actually engages at size m —
+        below the panel size it early-exits to the native solve, so
+        building/carrying panel inverses there would be pure waste."""
+        bs = self.config.trisolve_block_size
+        return bs > 0 and m > bs
+
+    def _chol_inv(self, chol_r: jnp.ndarray) -> jnp.ndarray:
+        """(q, nb, p, p) diagonal-panel inverses of the stacked factor
+        for the blocked triangular solves (panel_inverses batches over
+        the leading q axis itself)."""
+        return panel_inverses(chol_r, self.config.trisolve_block_size)
+
+    def _tri(self, l, b, inv=None):
+        """m-sized solve against the carried factor: blocked-GEMM form
+        (with optionally precomputed panel inverses) when configured,
+        XLA's native trisolve otherwise."""
+        bs = self.config.trisolve_block_size
+        if bs > 0:
+            return blocked_tri_solve(l, b, bs, inv)
+        return tri_solve(l, b)
+
+    def _solve_cache(self, dist, mask, state) -> Optional[SolveCache]:
+        """Cache for the current (phi, chol_r) — the scan-entry (and
+        chunk-boundary) build; deterministic in the carried state, so
+        rebuilding here is bit-identical to the carried value."""
         cfg = self.config
-        if cfg.u_solver != "cg":
-            return None  # dense path: the O(m^2) rebuild is noise
-            # next to its O(m^3) per-sweep factorization
-        r_full = masked_correlation(
-            dist[None], phi[:, None, None], mask, cfg.cov_model
-        )
-        return self._cache_from_r(r_full)
+        r_mv = nys_z = chol_inv = None
+        if cfg.u_solver == "cg":
+            r_full = masked_correlation(
+                dist[None], state.phi[:, None, None], mask,
+                cfg.cov_model,
+            )
+            r_mv, nys_z = self._r_operators(r_full)
+        # dense u path: the O(m^2) rebuild is noise next to its
+        # O(m^3) per-sweep factorization, so no CG operators — but
+        # the blocked-trisolve panel inverses still pay off
+        if self._use_blocked_tri(state.chol_r.shape[-1]):
+            chol_inv = self._chol_inv(state.chol_r)
+        if r_mv is None and chol_inv is None:
+            return None
+        return SolveCache(r_mv=r_mv, nys_z=nys_z, chol_inv=chol_inv)
 
     # ------------------------------------------------------------------
     # Initialization
@@ -351,14 +392,23 @@ class SpatialGPSampler:
         lo = jnp.asarray(cfg.priors.phi_min, dtype)
         hi = jnp.asarray(cfg.priors.phi_max, dtype)
 
-        def u_loglik(chol_r):
+        def u_loglik(chol_r, inv):
             # (q, m, m) stacked factors vs (m, q) components. NOTE:
             # batching the proposal+current pair into one (2q, m, m)
             # trisolve was tried in r4 and REVERTED — the concat
             # materializes a second copy of both factors (~3.9 GB at
             # the north-star slice) and pushes the chip 186 MB over
             # HBM; two separate solves reuse the existing buffers.
-            alpha = jax.vmap(tri_solve)(chol_r, u.T[..., None])[..., 0]
+            # ``inv``: optional carried panel inverses for the
+            # blocked solve (SolveCache.chol_inv).
+            if inv is None:
+                alpha = jax.vmap(lambda l, bb: self._tri(l, bb))(
+                    chol_r, u.T[..., None]
+                )[..., 0]
+            else:
+                alpha = jax.vmap(self._tri)(
+                    chol_r, u.T[..., None], inv
+                )[..., 0]
             return -0.5 * jnp.sum(alpha * alpha, axis=-1) - 0.5 * chol_logdet(
                 chol_r
             )
@@ -380,10 +430,16 @@ class SpatialGPSampler:
                     cfg.cov_model,
                 )
                 chol_prop = self._chol_r(r_prop)
+            inv_cur = None if cache is None else cache.chol_inv
+            inv_prop = (
+                self._chol_inv(chol_prop)
+                if self._use_blocked_tri(m)
+                else None
+            )
             log_ratio = (
-                u_loglik(chol_prop)
+                u_loglik(chol_prop, inv_prop)
                 + log_jac_prop
-                - u_loglik(chol_cur)
+                - u_loglik(chol_cur, inv_cur)
                 - log_jac_cur
             )
             accept = jnp.log(
@@ -393,15 +449,30 @@ class SpatialGPSampler:
             if cache is None:
                 cache_new = None
             else:
-                # the proposal's correlation is in hand — refresh the
-                # carried solve operators for accepted components only
+                # the proposal's correlation/factor are in hand —
+                # refresh the carried solve operators for accepted
+                # components only
                 with jax.named_scope("cache_refresh"):
-                    cache_prop = self._cache_from_r(r_prop)
+                    if cache.r_mv is not None:
+                        r_mv_p, nys_z_p = self._r_operators(r_prop)
+                        r_mv_new = jnp.where(acc3, r_mv_p, cache.r_mv)
+                        nys_new = (
+                            None
+                            if cache.nys_z is None
+                            else jnp.where(acc3, nys_z_p, cache.nys_z)
+                        )
+                    else:
+                        r_mv_new = nys_new = None
+                    inv_new = (
+                        None
+                        if inv_prop is None
+                        else jnp.where(
+                            accept[:, None, None, None], inv_prop,
+                            cache.chol_inv,
+                        )
+                    )
                 cache_new = SolveCache(
-                    r_mv=jnp.where(acc3, cache_prop.r_mv, cache.r_mv),
-                    nys_z=None
-                    if cache.nys_z is None
-                    else jnp.where(acc3, cache_prop.nys_z, cache.nys_z),
+                    r_mv=r_mv_new, nys_z=nys_new, chol_inv=inv_new
                 )
             return (
                 jnp.where(accept, phi_prop, phi),
@@ -609,9 +680,14 @@ class SpatialGPSampler:
         )  # (q, t, t)
 
         @jax.named_scope("krige")
-        def krige(l_j, rc_j, rt_j, u_j, key_j):
-            v = tri_solve(l_j, rc_j)  # (m, t)
-            alpha = tri_solve(l_j, u_j)  # (m,)
+        def krige(l_j, rc_j, rt_j, u_j, key_j, inv_j):
+            # the two m-sized solves ride the blocked-GEMM trisolve
+            # with the carried panel inverses when configured — XLA's
+            # native trisolve here is latency-bound (~30 ms/iter at
+            # the north-star slice, the sampling-phase overhead the
+            # r4 burn-vs-samp probe measured)
+            v = self._tri(l_j, rc_j, inv_j)  # (m, t)
+            alpha = self._tri(l_j, u_j, inv_j)  # (m,)
             cond_mean = v.T @ alpha
             cond_cov = rt_j - v.T @ v
             # jitter at the m-derived scale: cond_cov's entries come
@@ -621,9 +697,15 @@ class SpatialGPSampler:
             z = jax.random.normal(key_j, (t_test,), dtype)
             return cond_mean + chol_c @ z
 
-        u_star_test = jax.vmap(krige)(
-            chol_r, r_cross, r_test, u.T, jax.random.split(kpred, q)
-        )  # (q, t)
+        kpred_q = jax.random.split(kpred, q)
+        if cache is not None and cache.chol_inv is not None:
+            u_star_test = jax.vmap(krige)(
+                chol_r, r_cross, r_test, u.T, kpred_q, cache.chol_inv
+            )  # (q, t)
+        else:
+            u_star_test = jax.vmap(
+                lambda a, b, c, d, e: krige(a, b, c, d, e, None)
+            )(chol_r, r_cross, r_test, u.T, kpred_q)
         w_star = (u_star_test.T @ a.T).reshape(-1)  # (t*q,) response-fastest
 
         # parameter vector: beta, lower-tri(K = A A^T), phi — the
@@ -706,9 +788,7 @@ class SpatialGPSampler:
 
     def _burn_in(self, data, init_state):
         consts = self._consts(data)
-        cache = self._solve_cache(
-            consts[0], data.mask, init_state.phi
-        )
+        cache = self._solve_cache(consts[0], data.mask, init_state)
         step = lambda st, it: (
             self._gibbs_step(data, consts, st, it, collect=False)[0],
             None,
@@ -734,7 +814,7 @@ class SpatialGPSampler:
         rates are post-burn-in."""
         with jax.default_matmul_precision(self.config.matmul_precision):
             consts = self._consts(data)
-            cache = self._solve_cache(consts[0], data.mask, state.phi)
+            cache = self._solve_cache(consts[0], data.mask, state)
             step = lambda st, it: (
                 self._gibbs_step(data, consts, st, it, collect=False)[0],
                 None,
@@ -761,7 +841,7 @@ class SpatialGPSampler:
 
     def _sample_chunk(self, data, state, start_it, n_iters):
         consts = self._consts(data)
-        cache = self._solve_cache(consts[0], data.mask, state.phi)
+        cache = self._solve_cache(consts[0], data.mask, state)
         step = lambda st, it: self._gibbs_step(
             data, consts, st, it, collect=True
         )

@@ -109,6 +109,113 @@ def tri_solve(chol_l: jnp.ndarray, b: jnp.ndarray, *, trans: bool = False) -> jn
     return solve_triangular(chol_l, b, lower=True, trans=1 if trans else 0)
 
 
+def blocked_tri_solve(
+    l: jnp.ndarray,
+    b: jnp.ndarray,
+    block_size: int = 512,
+    inv_diag: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Solve L X = B (L lower-triangular) via explicit panel inverses —
+    forward substitution reshaped so the work is GEMMs.
+
+    XLA's native triangular solve at the sampler's shapes is
+    latency-bound, not bandwidth-bound: measured in-scan at
+    (32, 3906, 3906) on v5e it costs ~30 ms per application whether
+    the right-hand side has 1 or 64 columns — ~13x the 2.4 ms HBM
+    floor of streaming the factor once (the sequential panel
+    recurrence serializes). This form inverts the (p, p) diagonal
+    panels once per call (one batched SMALL trisolve whose recurrence
+    is p long, not m) and turns the substitution into one
+    (p, i*p) @ (i*p, t) GEMM per panel — the same m^2*t/2 flops,
+    MXU-shaped, one streaming pass over L. Same numerics as tri_solve
+    up to fp reassociation (the explicit p x p triangular inverse is
+    the trick nystrom_factor and blocked_cholesky already use).
+
+    l: (..., m, m); b: (..., m) or (..., m, t). m is padded internally
+    to a block_size multiple with an identity diagonal (padding rows
+    solve to zero and are sliced away).
+
+    ``inv_diag``: optionally the precomputed :func:`panel_inverses`
+    of ``l`` — the diagonal-panel inversion is the call's serial
+    part, and the sampler's factor changes only on phi acceptance, so
+    carrying the inverses beside it (SolveCache) amortizes the build
+    to one per phi update.
+    """
+    m = l.shape[-1]
+    vec = b.ndim == l.ndim - 1
+    if vec:
+        b = b[..., None]
+    if m <= block_size:
+        x = solve_triangular(l, b, lower=True)
+        return x[..., 0] if vec else x
+    p = block_size
+    nb = -(-m // p)
+    mp = nb * p
+    batch = l.shape[:-2]
+    if inv_diag is None:
+        inv_diag = panel_inverses(l, block_size)
+    if mp != m:
+        pad = mp - m
+        zpad_r = jnp.zeros(batch + (m, pad), l.dtype)
+        eye_pad = jnp.broadcast_to(
+            jnp.eye(pad, dtype=l.dtype), batch + (pad, pad)
+        )
+        top = jnp.concatenate([l, zpad_r], axis=-1)
+        bot = jnp.concatenate(
+            [jnp.swapaxes(zpad_r, -1, -2), eye_pad], axis=-1
+        )
+        l = jnp.concatenate([top, bot], axis=-2)
+        b = jnp.concatenate(
+            [b, jnp.zeros(batch + (pad, b.shape[-1]), b.dtype)], axis=-2
+        )
+    xs = []
+    for i in range(nb):
+        rhs = b[..., i * p : (i + 1) * p, :]
+        if i:
+            xprev = jnp.concatenate(xs, axis=-2)  # (..., i*p, t)
+            rhs = rhs - l[..., i * p : (i + 1) * p, : i * p] @ xprev
+        xs.append(inv_diag[..., i, :, :] @ rhs)
+    x = jnp.concatenate(xs, axis=-2)[..., :m, :]
+    return x[..., 0] if vec else x
+
+
+def panel_inverses(l: jnp.ndarray, block_size: int) -> jnp.ndarray:
+    """(..., nb, p, p) explicit inverses of L's diagonal panels — the
+    precomputable half of :func:`blocked_tri_solve` (one batched
+    trisolve whose recurrence is p long; everything else is GEMM).
+    Ragged tails get an identity-padded panel, matching the padding
+    blocked_tri_solve applies."""
+    m = l.shape[-1]
+    p = block_size
+    nb = -(-m // p)
+    eye_p = jnp.eye(p, dtype=l.dtype)
+    panels = []
+    for i in range(nb):
+        lo, hi = i * p, min((i + 1) * p, m)
+        blk = l[..., lo:hi, lo:hi]
+        if hi - lo < p:
+            pad = p - (hi - lo)
+            batch = l.shape[:-2]
+            z = jnp.zeros(batch + (hi - lo, pad), l.dtype)
+            ep = jnp.broadcast_to(
+                jnp.eye(pad, dtype=l.dtype), batch + (pad, pad)
+            )
+            blk = jnp.concatenate(
+                [
+                    jnp.concatenate([blk, z], axis=-1),
+                    jnp.concatenate(
+                        [jnp.swapaxes(z, -1, -2), ep], axis=-1
+                    ),
+                ],
+                axis=-2,
+            )
+        panels.append(blk)
+    diag = jnp.stack(panels, axis=-3)  # (..., nb, p, p)
+    return solve_triangular(
+        diag, jnp.broadcast_to(eye_p, diag.shape), lower=True
+    )
+
+
 def chol_solve(chol_l: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Solve (L L^T) x = b given the lower factor L."""
     return tri_solve(chol_l, tri_solve(chol_l, b), trans=True)
