@@ -199,6 +199,22 @@ class SMKConfig:
     # reference-faithful small-m path; the bench sets 512.
     trisolve_block_size: int = 0
 
+    # Cached kriging operators for the sampling phase: carry
+    # W = R^{-1} R_cross and chol(R_test - R_cross^T W) in the
+    # SolveCache (phi-only; rebuilt on every phi-UPDATE sweep inside
+    # the MH branch — acceptance only selects which value is kept —
+    # so the t-rhs solve pair amortizes over phi_update_every sweeps,
+    # not over accepts) so each kept draw's composition-sampling
+    # conditional (spPredict equivalent, R:85-87) is a GEMV instead
+    # of two m-sized trisolves — the r4
+    # burn-vs-samp probe billed those at ~15 ms/iter of
+    # sampling-phase overhead at the north-star slice. Same
+    # conditional law (fp reassociation only); the chain itself is
+    # bit-identical either way because the predictive draw never
+    # feeds back into the carried state. False restores the r4
+    # per-draw solve path.
+    krige_cache: bool = True
+
     # Pólya-Gamma series truncation for the logit link: omega is drawn
     # from the defining infinite series cut at this many terms with
     # the dropped tail replaced by its mean, so the logit chain

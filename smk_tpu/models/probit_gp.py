@@ -146,11 +146,27 @@ class SolveCache(NamedTuple):
            log-likelihood and kriging conditionals — ops/chol.py
            blocked_tri_solve); None when trisolve_block_size == 0 or
            m is too small for the blocked solve to engage.
+    krige_w: (q, m, t) W = R~^{-1} R_cross — the kriging weights. The
+           composition-sampling draw (spPredict equivalent, R:85-87)
+           needs cond_mean = R_c^T R^{-1} u per kept iteration; W is a
+           pure function of phi, so carrying it turns the two m-sized
+           per-draw trisolves the r4 probe measured at ~15 ms/iter of
+           sampling-phase overhead into one (t, m) @ (m,) GEMV. Built
+           only for collecting scans (burn-in carries None) and
+           rebuilt on every phi-UPDATE sweep inside the MH branch
+           (acceptance only selects which value is kept), so the
+           t-rhs blocked-solve pair amortizes over phi_update_every
+           sweeps.
+    krige_chol: (q, t, t) Cholesky of the phi-only conditional
+           covariance R_test - W^T R_cross (+ jitter), cached for the
+           same reason.
     """
 
     r_mv: Optional[jnp.ndarray]
     nys_z: Optional[jnp.ndarray]
     chol_inv: Optional[jnp.ndarray]
+    krige_w: Optional[jnp.ndarray] = None
+    krige_chol: Optional[jnp.ndarray] = None
 
 
 class SubsetResult(NamedTuple):
@@ -256,21 +272,54 @@ class SpatialGPSampler:
         the leading q axis itself)."""
         return panel_inverses(chol_r, self.config.trisolve_block_size)
 
-    def _tri(self, l, b, inv=None):
+    def _tri(self, l, b, inv=None, *, trans: bool = False):
         """m-sized solve against the carried factor: blocked-GEMM form
         (with optionally precomputed panel inverses) when configured,
         XLA's native trisolve otherwise."""
         bs = self.config.trisolve_block_size
         if bs > 0:
-            return blocked_tri_solve(l, b, bs, inv)
-        return tri_solve(l, b)
+            return blocked_tri_solve(l, b, bs, inv, trans=trans)
+        return tri_solve(l, b, trans=trans)
 
-    def _solve_cache(self, dist, mask, state) -> Optional[SolveCache]:
+    def _krige_ops(self, chol_r, phi, mask, dist_cross, dist_test, inv):
+        """(krige_w, krige_chol) for the carried factor — the phi-only
+        halves of the composition-sampling draw (spPredict, R:85-87):
+        W = R~^{-1} R_c (pad rows of R_c zeroed so pad latents cannot
+        leak into the test sites) and chol(R_t - R_c^T W + jitter).
+        One t-rhs solve pair per call, amortized over phi updates."""
+        cfg = self.config
+        r_cross = mask[None, :, None] * correlation(
+            dist_cross[None], phi[:, None, None], cfg.cov_model
+        )  # (q, m, t)
+        r_test = correlation(
+            dist_test[None], phi[:, None, None], cfg.cov_model
+        )  # (q, t, t)
+        jit_eff = cfg.effective_jitter(chol_r.shape[-1])
+
+        def one(l_j, rc_j, rt_j, inv_j):
+            v = self._tri(l_j, rc_j, inv_j)  # (m, t)
+            w_j = self._tri(l_j, v, inv_j, trans=True)  # R^{-1} rc
+            cond_cov = rt_j - rc_j.T @ w_j
+            return w_j, jittered_cholesky(cond_cov, jit_eff)
+
+        if inv is not None:
+            return jax.vmap(one)(chol_r, r_cross, r_test, inv)
+        return jax.vmap(lambda a, b, c: one(a, b, c, None))(
+            chol_r, r_cross, r_test
+        )
+
+    def _solve_cache(
+        self, dist, mask, state, *, consts=None, predict: bool = False
+    ) -> Optional[SolveCache]:
         """Cache for the current (phi, chol_r) — the scan-entry (and
         chunk-boundary) build; deterministic in the carried state, so
-        rebuilding here is bit-identical to the carried value."""
+        rebuilding here is bit-identical to the carried value.
+
+        ``predict=True`` (collecting scans only) additionally builds
+        the kriging operators from ``consts``' cross/test distances —
+        burn-in scans never pay for or carry them."""
         cfg = self.config
-        r_mv = nys_z = chol_inv = None
+        r_mv = nys_z = chol_inv = krige_w = krige_chol = None
         if cfg.u_solver == "cg":
             r_full = masked_correlation(
                 dist[None], state.phi[:, None, None], mask,
@@ -282,9 +331,17 @@ class SpatialGPSampler:
         # the blocked-trisolve panel inverses still pay off
         if self._use_blocked_tri(state.chol_r.shape[-1]):
             chol_inv = self._chol_inv(state.chol_r)
-        if r_mv is None and chol_inv is None:
+        if predict and cfg.krige_cache:
+            krige_w, krige_chol = self._krige_ops(
+                state.chol_r, state.phi, mask, consts[1], consts[2],
+                chol_inv,
+            )
+        if r_mv is None and chol_inv is None and krige_w is None:
             return None
-        return SolveCache(r_mv=r_mv, nys_z=nys_z, chol_inv=chol_inv)
+        return SolveCache(
+            r_mv=r_mv, nys_z=nys_z, chol_inv=chol_inv,
+            krige_w=krige_w, krige_chol=krige_chol,
+        )
 
     # ------------------------------------------------------------------
     # Initialization
@@ -471,8 +528,18 @@ class SpatialGPSampler:
                             cache.chol_inv,
                         )
                     )
+                    if cache.krige_w is not None:
+                        kw_p, kc_p = self._krige_ops(
+                            chol_prop, phi_prop, mask, dist_cross,
+                            dist_test, inv_prop,
+                        )
+                        kw_new = jnp.where(acc3, kw_p, cache.krige_w)
+                        kc_new = jnp.where(acc3, kc_p, cache.krige_chol)
+                    else:
+                        kw_new = kc_new = None
                 cache_new = SolveCache(
-                    r_mv=r_mv_new, nys_z=nys_new, chol_inv=inv_new
+                    r_mv=r_mv_new, nys_z=nys_new, chol_inv=inv_new,
+                    krige_w=kw_new, krige_chol=kc_new,
                 )
             return (
                 jnp.where(accept, phi_prop, phi),
@@ -672,40 +739,63 @@ class SpatialGPSampler:
         # Pad rows of the cross-covariance are zeroed: pad latents are
         # prior-only noise and must not leak into the test sites.
         t_test = data.coords_test.shape[0]
-        r_cross = mask[None, :, None] * correlation(
-            dist_cross[None], phi[:, None, None], cfg.cov_model
-        )  # (q, m, t)
-        r_test = correlation(
-            dist_test[None], phi[:, None, None], cfg.cov_model
-        )  # (q, t, t)
-
-        @jax.named_scope("krige")
-        def krige(l_j, rc_j, rt_j, u_j, key_j, inv_j):
-            # the two m-sized solves ride the blocked-GEMM trisolve
-            # with the carried panel inverses when configured — XLA's
-            # native trisolve here is latency-bound (~30 ms/iter at
-            # the north-star slice, the sampling-phase overhead the
-            # r4 burn-vs-samp probe measured)
-            v = self._tri(l_j, rc_j, inv_j)  # (m, t)
-            alpha = self._tri(l_j, u_j, inv_j)  # (m,)
-            cond_mean = v.T @ alpha
-            cond_cov = rt_j - v.T @ v
-            # jitter at the m-derived scale: cond_cov's entries come
-            # from m-length fp32 contractions, whose roundoff (not t)
-            # sets the PD margin here
-            chol_c = jittered_cholesky(cond_cov, jit_eff)
-            z = jax.random.normal(key_j, (t_test,), dtype)
-            return cond_mean + chol_c @ z
-
         kpred_q = jax.random.split(kpred, q)
-        if cache is not None and cache.chol_inv is not None:
-            u_star_test = jax.vmap(krige)(
-                chol_r, r_cross, r_test, u.T, kpred_q, cache.chol_inv
-            )  # (q, t)
+        if cache is not None and cache.krige_w is not None:
+            # cached-operator path: W = R^{-1} R_c and chol(cond_cov)
+            # are phi-only and carried in the SolveCache (refreshed on
+            # phi acceptance), so each kept draw is one (t, m) GEMV +
+            # one (t, t) matvec — the two per-draw m-sized trisolves
+            # the r4 probe billed ~15 ms/iter of sampling overhead to
+            # are gone. Same conditional law; only the fp association
+            # of cond_mean differs (R_c^T (R^{-1} u) vs the trisolve
+            # pair), so the chain itself is bit-identical (the krige
+            # draw never feeds back into the state).
+            with jax.named_scope("krige"):
+                cond_mean = jnp.einsum("qmt,mq->qt", cache.krige_w, u)
+                z = jax.vmap(
+                    lambda kk: jax.random.normal(kk, (t_test,), dtype)
+                )(kpred_q)
+                u_star_test = cond_mean + jnp.einsum(
+                    "qts,qs->qt", cache.krige_chol, z
+                )
         else:
-            u_star_test = jax.vmap(
-                lambda a, b, c, d, e: krige(a, b, c, d, e, None)
-            )(chol_r, r_cross, r_test, u.T, kpred_q)
+            r_cross = mask[None, :, None] * correlation(
+                dist_cross[None], phi[:, None, None], cfg.cov_model
+            )  # (q, m, t)
+            r_test = correlation(
+                dist_test[None], phi[:, None, None], cfg.cov_model
+            )  # (q, t, t)
+
+            @jax.named_scope("krige")
+            def krige(l_j, rc_j, rt_j, u_j, key_j, inv_j):
+                # the two m-sized solves ride the blocked-GEMM
+                # trisolve with the carried panel inverses when
+                # configured — XLA's native trisolve here is
+                # latency-bound (~30 ms/iter at the north-star slice,
+                # the sampling-phase overhead the r4 burn-vs-samp
+                # probe measured)
+                v = self._tri(l_j, rc_j, inv_j)  # (m, t)
+                alpha = self._tri(l_j, u_j, inv_j)  # (m,)
+                cond_mean = v.T @ alpha
+                cond_cov = rt_j - v.T @ v
+                # jitter at the m-derived scale: cond_cov's entries
+                # come from m-length fp32 contractions, whose roundoff
+                # (not t) sets the PD margin here
+                chol_c = jittered_cholesky(cond_cov, jit_eff)
+                z = jax.random.normal(key_j, (t_test,), dtype)
+                return cond_mean + chol_c @ z
+
+            if cache is not None and cache.chol_inv is not None:
+                u_star_test = jax.vmap(krige)(
+                    chol_r, r_cross, r_test, u.T, kpred_q,
+                    cache.chol_inv,
+                )  # (q, t)
+            else:
+                u_star_test = jax.vmap(
+                    lambda a2, b2, c2, d2, e2: krige(
+                        a2, b2, c2, d2, e2, None
+                    )
+                )(chol_r, r_cross, r_test, u.T, kpred_q)
         w_star = (u_star_test.T @ a.T).reshape(-1)  # (t*q,) response-fastest
 
         # parameter vector: beta, lower-tri(K = A A^T), phi — the
@@ -841,7 +931,9 @@ class SpatialGPSampler:
 
     def _sample_chunk(self, data, state, start_it, n_iters):
         consts = self._consts(data)
-        cache = self._solve_cache(consts[0], data.mask, state)
+        cache = self._solve_cache(
+            consts[0], data.mask, state, consts=consts, predict=True
+        )
         step = lambda st, it: self._gibbs_step(
             data, consts, st, it, collect=True
         )

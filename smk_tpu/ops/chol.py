@@ -114,9 +114,12 @@ def blocked_tri_solve(
     b: jnp.ndarray,
     block_size: int = 512,
     inv_diag: jnp.ndarray | None = None,
+    *,
+    trans: bool = False,
 ) -> jnp.ndarray:
-    """Solve L X = B (L lower-triangular) via explicit panel inverses —
-    forward substitution reshaped so the work is GEMMs.
+    """Solve L X = B (or L^T X = B when ``trans``) via explicit panel
+    inverses — forward (resp. backward) substitution reshaped so the
+    work is GEMMs.
 
     XLA's native triangular solve at the sampler's shapes is
     latency-bound, not bandwidth-bound: measured in-scan at
@@ -140,13 +143,19 @@ def blocked_tri_solve(
     part, and the sampler's factor changes only on phi acceptance, so
     carrying the inverses beside it (SolveCache) amortizes the build
     to one per phi update.
+
+    ``trans=True`` runs the backward substitution for L^T X = B with
+    the SAME panel inverses ((L^T)_ii^{-1} = inv_ii^T) — composing
+    the two directions applies the full (L L^T)^{-1} with every
+    factor stream a GEMM (the kriging-weight build in
+    models/probit_gp.py:_krige_ops does exactly that).
     """
     m = l.shape[-1]
     vec = b.ndim == l.ndim - 1
     if vec:
         b = b[..., None]
     if m <= block_size:
-        x = solve_triangular(l, b, lower=True)
+        x = solve_triangular(l, b, lower=True, trans=1 if trans else 0)
         return x[..., 0] if vec else x
     p = block_size
     nb = -(-m // p)
@@ -168,6 +177,25 @@ def blocked_tri_solve(
         b = jnp.concatenate(
             [b, jnp.zeros(batch + (pad, b.shape[-1]), b.dtype)], axis=-2
         )
+    if trans:
+        # backward: x_i = inv_ii^T (b_i - sum_{j>i} L[j,i]^T x_j);
+        # padded tail blocks solve to zero first and contribute
+        # nothing to the real blocks (their L columns are zero)
+        xs_rev = []
+        for i in range(nb - 1, -1, -1):
+            rhs = b[..., i * p : (i + 1) * p, :]
+            if i < nb - 1:
+                xnext = jnp.concatenate(
+                    list(reversed(xs_rev)), axis=-2
+                )  # (..., (nb-1-i)*p, t)
+                rhs = rhs - jnp.swapaxes(
+                    l[..., (i + 1) * p :, i * p : (i + 1) * p], -1, -2
+                ) @ xnext
+            xs_rev.append(
+                jnp.swapaxes(inv_diag[..., i, :, :], -1, -2) @ rhs
+            )
+        x = jnp.concatenate(list(reversed(xs_rev)), axis=-2)[..., :m, :]
+        return x[..., 0] if vec else x
     xs = []
     for i in range(nb):
         rhs = b[..., i * p : (i + 1) * p, :]

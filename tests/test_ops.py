@@ -419,3 +419,48 @@ class TestBlockedTriSolve:
             atol=1e-5 * scale,
         )
         assert x_fresh.shape == (m, t) and y_block.shape == (m,)
+
+    @pytest.mark.parametrize(
+        "m,t,bs", [(700, 16, 256), (300, 5, 512), (976, 64, 128)]
+    )
+    def test_transpose_matches_native(self, m, t, bs):
+        """trans=True (backward substitution with the SAME panel
+        inverses) matches the native L^T solve — the second pass of
+        the cached kriging-weight build W = R^{-1} R_cross
+        (SolveCache.krige_w)."""
+        from smk_tpu.ops.chol import (
+            blocked_tri_solve,
+            panel_inverses,
+            tri_solve,
+        )
+
+        rng = np.random.default_rng(7 * m + t)
+        c = jnp.asarray(rng.uniform(size=(m, 2)), jnp.float32)
+        r = correlation(pairwise_distance(c), 6.0, "exponential")
+        b = jnp.asarray(rng.normal(size=(m, t)), jnp.float32)
+        with jax.default_matmul_precision("highest"):
+            l = jittered_cholesky(r, 1e-4)
+            inv = panel_inverses(l, bs)
+            x_native = tri_solve(l, b, trans=True)
+            x_block = jax.jit(
+                lambda ll, bb, iv: blocked_tri_solve(
+                    ll, bb, bs, iv, trans=True
+                )
+            )(l, b, inv)
+            y_native = tri_solve(l, b[:, 0], trans=True)
+            y_block = blocked_tri_solve(l, b[:, 0], bs, inv, trans=True)
+        scale = float(jnp.max(jnp.abs(x_native))) + 1e-9
+        np.testing.assert_allclose(
+            np.asarray(x_block) / scale, np.asarray(x_native) / scale,
+            atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(y_block), np.asarray(y_native),
+            atol=1e-5 * scale,
+        )
+        # round-trip: the two directions together apply (L L^T)^{-1}
+        full = blocked_tri_solve(
+            l, blocked_tri_solve(l, b, bs, inv), bs, inv, trans=True
+        )
+        resid = (r + 1e-4 * jnp.eye(m)) @ full - b
+        assert float(jnp.max(jnp.abs(resid))) < 1e-3 * scale

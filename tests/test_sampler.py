@@ -449,3 +449,70 @@ class TestNystromMultivariateLogit:
         assert np.isfinite(np.asarray(res.w_samples)).all()
         acc = np.asarray(res.phi_accept_rate)
         assert (acc > 0.02).all() and (acc < 0.999).all(), acc
+
+
+class TestKrigeCache:
+    """The cached kriging operators (SolveCache.krige_w/krige_chol —
+    W = R^{-1} R_cross and the phi-only conditional-covariance factor,
+    refreshed on phi acceptance) produce the SAME chain bit-for-bit
+    (the predictive draw never feeds back into the state) and
+    fp-equivalent predictive draws vs the per-draw trisolve path, for
+    both links and for the dense-u solver."""
+
+    @pytest.mark.parametrize(
+        "link,u_solver", [("probit", "cg"), ("logit", "cg"),
+                          ("probit", "chol")]
+    )
+    def test_cached_vs_per_draw(self, link, u_solver):
+        import dataclasses
+
+        data, _ = synthetic_subset(
+            jax.random.key(21), 96, 2, 2,
+            [5.0, 9.0], [[1.0, 0.0], [0.4, 0.9]],
+            [[0.6, -0.4], [0.3, 0.7]],
+        )
+        base = SMKConfig(
+            n_subsets=1, n_samples=80, burn_in_frac=0.5,
+            phi_update_every=2, link=link, u_solver=u_solver,
+            cg_iters=24, trisolve_block_size=32,
+        )
+        out = {}
+        for kc in (True, False):
+            cfg = dataclasses.replace(base, krige_cache=kc)
+            model = SpatialProbitGP(cfg, weight=1)
+            st = model.init_state(jax.random.key(5), data)
+            out[kc] = jax.jit(model.run)(data, st)
+        assert jnp.array_equal(
+            out[True].param_samples, out[False].param_samples
+        ), "chain must be independent of the kriging path"
+        w_t = np.asarray(out[True].w_samples)
+        w_f = np.asarray(out[False].w_samples)
+        scale = np.abs(w_f).max() + 1e-9
+        np.testing.assert_allclose(
+            w_t / scale, w_f / scale, atol=5e-4
+        )
+
+    def test_chunked_matches_one_shot_with_cache(self):
+        """Chunk boundaries rebuild krige_w/krige_chol from the
+        carried state — bit-identical draws to an unchunked sampling
+        scan (the kill/resume invariant, now covering the cached
+        kriging operators)."""
+        data, _ = synthetic_subset(
+            jax.random.key(23), 80, 1, 2, [6.0], [[1.0]], [[0.5, -0.3]]
+        )
+        cfg = SMKConfig(
+            n_subsets=1, n_samples=60, burn_in_frac=0.5,
+            phi_update_every=2, u_solver="cg", cg_iters=24,
+            trisolve_block_size=32,
+        )
+        model = SpatialProbitGP(cfg, weight=1)
+        st = model.burn_in(data, model.init_state(jax.random.key(5), data))
+        one = model.sample_chunk(data, st, jnp.asarray(cfg.n_burn_in), 30)
+        s, it, pds, wds = st, cfg.n_burn_in, [], []
+        for ln in (10, 20):
+            s, (pd, wd) = model.sample_chunk(data, s, jnp.asarray(it), ln)
+            pds.append(pd)
+            wds.append(wd)
+            it += ln
+        assert jnp.array_equal(jnp.concatenate(pds), one[1][0])
+        assert jnp.array_equal(jnp.concatenate(wds), one[1][1])
