@@ -46,10 +46,10 @@ Environment knobs: BENCH_LADDER=full|config2 (default full on TPU,
 config2 elsewhere), BENCH_BUDGET_S (default 1450 — the driver kills
 at ~1800 s; leave headroom for interpreter + data-gen + compiles),
 BENCH_SAMPLES / BENCH_CG_ITERS / BENCH_CG_PRECOND / BENCH_CG_RANK /
-BENCH_CG_DTYPE / BENCH_PHI_EVERY / BENCH_USOLVER / BENCH_CHUNK_ITERS /
-BENCH_CHOL_BLOCK / BENCH_TRI_BLOCK / BENCH_A_PRIOR / BENCH_TEMPER
-override the solver settings (defaults below are the validated
-scaling-regime configuration).
+BENCH_CG_DTYPE / BENCH_PHI_EVERY / BENCH_PHI_SAMPLER / BENCH_USOLVER /
+BENCH_CHUNK_ITERS / BENCH_CHOL_BLOCK / BENCH_TRI_BLOCK /
+BENCH_A_PRIOR / BENCH_TEMPER override the solver settings (defaults
+below are the validated scaling-regime configuration).
 
 Synthetic latent surfaces use random Fourier features (an O(n)
 stationary GP approximation) so data generation never needs an n x n
@@ -158,8 +158,12 @@ def op_model(cfg, m, k, q, n_iters, n_kept, t):
         # dense path: (R + D) Cholesky + solve per sweep per component
         cg_flops = per_comp * n_iters * (m**3 / 3 + 4 * m * m)
     ustar_flops = per_comp * n_iters * 2 * m * m
-    # phi MH: proposal Cholesky m^3/3 + rebuild + two triangular solves
-    chol_flops = per_comp * n_phi * (m**3 / 3 + 4 * m * m)
+    # phi MH: proposal Cholesky m^3/3 + rebuild + two triangular
+    # solves; the collapsed sampler factors three matrices per update
+    # (S at current and proposed phi + R(phi') for the carried prior
+    # factor — see SMKConfig.phi_sampler)
+    n_chol = 3 if getattr(cfg, "phi_sampler", "conditional") == "collapsed" else 1
+    chol_flops = per_comp * n_phi * (n_chol * m**3 / 3 + 4 * m * m)
     # kriging (collect iters): v = trisolve(L, rc) m^2 t; cond_cov t^2 m
     krige_flops = per_comp * n_kept * (m * m * t + 2 * t * t * m)
     flops = cg_flops + ustar_flops + chol_flops + krige_flops
@@ -318,6 +322,7 @@ def run_rung(name, *, n, k, cov_model, n_samples, q=1, p=2, n_test=64,
         cg_precond_rank=int(env.get("BENCH_CG_RANK", 256)),
         cg_matvec_dtype=env.get("BENCH_CG_DTYPE", "bfloat16"),
         phi_update_every=int(env.get("BENCH_PHI_EVERY", 4)),
+        phi_sampler=env.get("BENCH_PHI_SAMPLER", "conditional"),
         chol_block_size=int(env.get("BENCH_CHOL_BLOCK", 0)),
         # blocked-GEMM trisolves with carried panel inverses: XLA's
         # native trisolve is latency-bound at these shapes (measured

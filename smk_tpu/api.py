@@ -318,9 +318,16 @@ def fit_meta_kriging(
         param_rhat=results.param_rhat,
         w_ess=results.w_ess,
         w_rhat=results.w_rhat,
-        latent_ess_per_sec=float(
-            jnp.sum(jnp.nan_to_num(results.w_ess, nan=0.0))
-            / max(times.as_dict().get("subset_fits", 0.0), 1e-9)
+        # 0.0 (not a silently ~1e9x-inflated rate) when the phase
+        # clock recorded nothing — a missing/zero 'subset_fits' means
+        # the timer contract was broken and the metric is undefined
+        latent_ess_per_sec=(
+            float(
+                jnp.sum(jnp.nan_to_num(results.w_ess, nan=0.0))
+                / times.as_dict()["subset_fits"]
+            )
+            if times.as_dict().get("subset_fits", 0.0) > 0.0
+            else 0.0
         ),
         phase_seconds=times.as_dict(),
     )

@@ -135,6 +135,31 @@ class SMKConfig:
     # mixing for wall-clock at large m.
     phi_update_every: int = 1
 
+    # HOW phi is Metropolis-updated:
+    # - "conditional": random-walk MH on p(phi_j | u_j) — the prior
+    #   density ratio of the current component GP draw (1 proposal
+    #   Cholesky per update; the current factor is carried). Mixing is
+    #   throttled by the tight u-phi coupling: the conditional is far
+    #   narrower than the marginal posterior (measured per-chain phi
+    #   ESS 5-7 over 5000 iterations at bench scale, r4).
+    # - "collapsed": random-walk MH on p(phi_j | z, beta, A, u_{-j})
+    #   with u_j INTEGRATED OUT — the component's augmented-likelihood
+    #   marginal ytilde ~ N(0, R_j(phi) + jitter I + D) is closed-form
+    #   because the link augmentation is Gaussian (a payoff of the
+    #   conjugate redesign: spBayes's logit likelihood admits no such
+    #   marginal, so the reference's sampler could never do this).
+    #   Each update costs THREE m^3 factorizations instead of one
+    #   (S(phi_cur) and S(phi_prop) — D moves with omega/A every
+    #   sweep, so the current S factor cannot be carried — plus
+    #   R(phi') to refresh the carried prior factor on accept), so
+    #   pair it with a sparser phi_update_every; in exchange each
+    #   update moves at the marginal posterior's scale instead of the
+    #   narrow conditional's. Validity: the update immediately
+    #   precedes the u_j redraw from its full conditional (a
+    #   partially-collapsed Gibbs block); for q > 1, components are
+    #   updated sequentially inside the u loop.
+    phi_sampler: str = "conditional"
+
     # Solver for the u-update's (R + D) system: "chol" = exact dense
     # Cholesky; "cg" = fixed-iteration conjugate gradient with R
     # applied directly from a matvec matrix CARRIED across sweeps
@@ -328,6 +353,10 @@ class SMKConfig:
             )
         if self.phi_update_every < 1:
             raise ValueError("phi_update_every must be >= 1")
+        if self.phi_sampler not in ("conditional", "collapsed"):
+            raise ValueError(
+                "phi_sampler must be 'conditional' or 'collapsed'"
+            )
         if self.n_chains < 1:
             raise ValueError("n_chains must be >= 1")
         if not 0.0 < self.phi_target_accept < 1.0:

@@ -148,6 +148,12 @@ def load_presence_absence_csv(
     kept: on a drop-heavy multi-million-row export a kept-rows cap
     would silently read to end of file, so with drop policies active
     the returned dataset can hold fewer than ``max_rows`` rows.
+
+    Memory note: with ``checklist_id_col`` set, the dedupe set holds
+    every distinct id string seen — O(rows scanned) host memory (tens
+    of bytes per id). On a multi-million-row export bound the scan
+    with ``max_rows`` or pre-dedupe the export if that footprint
+    matters.
     """
     if na_policy not in ("error", "drop"):
         raise ValueError("na_policy must be 'error' or 'drop'")

@@ -308,6 +308,35 @@ class SpatialGPSampler:
             chol_r, r_cross, r_test
         )
 
+    def _proposal_operators(
+        self, r_prop, chol_prop, inv_prop, phi_prop, mask,
+        dist_cross, dist_test, cache,
+    ):
+        """Proposal-side values for every populated SolveCache field —
+        the ONE inventory both phi-MH refresh sites draw from (the
+        batched conditional step and the per-component collapsed
+        block), so adding a cache field forces both to handle it or
+        fail loudly here. Inputs carry a leading component axis
+        (batched q, or 1 for a single component); None fields mirror
+        the cache's population.
+
+        Returns a SolveCache of proposal values; the caller does the
+        accept-select (and, for the per-component site, the scatter).
+        """
+        cfg = self.config
+        r_mv_p = nys_p = kw_p = kc_p = None
+        if cache.r_mv is not None:
+            r_mv_p, nys_p = self._r_operators(r_prop)
+        if cache.krige_w is not None:
+            kw_p, kc_p = self._krige_ops(
+                chol_prop, phi_prop, mask, dist_cross, dist_test,
+                inv_prop,
+            )
+        return SolveCache(
+            r_mv=r_mv_p, nys_z=nys_p, chol_inv=inv_prop,
+            krige_w=kw_p, krige_chol=kc_p,
+        )
+
     def _solve_cache(
         self, dist, mask, state, *, consts=None, predict: bool = False
     ) -> Optional[SolveCache]:
@@ -442,10 +471,20 @@ class SpatialGPSampler:
         beta = mean_b + noise
         eta_fixed = jnp.einsum("mqp,qp->mq", data.x, beta)
 
-        # --- 3. phi | u (logit-RW MH on Unif support) -----------------
+        # --- 3. phi MH -----------------------------------------------
         # Runs every cfg.phi_update_every sweeps (deterministic-scan
         # Gibbs schedule); skipped sweeps pay zero Cholesky cost via
-        # lax.cond. This is the only remaining O(m^3) factorization.
+        # lax.cond (the predicate is iteration-indexed, identical
+        # across the vmapped K axis, so the cond stays a real branch
+        # under batching). This is the only remaining O(m^3)
+        # factorization site.
+        #
+        # "conditional" (here): batched random-walk MH on
+        # p(phi_j | u_j), the component-GP prior density ratio.
+        # "collapsed": deferred into the per-component u loop below —
+        # p(phi_j | z, beta, A, u_{-j}) with u_j integrated out, each
+        # update immediately followed by the u_j redraw (a
+        # partially-collapsed Gibbs block; see SMKConfig.phi_sampler).
         lo = jnp.asarray(cfg.priors.phi_min, dtype)
         hi = jnp.asarray(cfg.priors.phi_max, dtype)
 
@@ -508,39 +547,34 @@ class SpatialGPSampler:
             else:
                 # the proposal's correlation/factor are in hand —
                 # refresh the carried solve operators for accepted
-                # components only
+                # components only (_proposal_operators is the single
+                # field inventory shared with the collapsed block's
+                # refresh and the chunk-boundary rebuild)
                 with jax.named_scope("cache_refresh"):
-                    if cache.r_mv is not None:
-                        r_mv_p, nys_z_p = self._r_operators(r_prop)
-                        r_mv_new = jnp.where(acc3, r_mv_p, cache.r_mv)
-                        nys_new = (
-                            None
-                            if cache.nys_z is None
-                            else jnp.where(acc3, nys_z_p, cache.nys_z)
-                        )
-                    else:
-                        r_mv_new = nys_new = None
-                    inv_new = (
-                        None
-                        if inv_prop is None
-                        else jnp.where(
-                            accept[:, None, None, None], inv_prop,
-                            cache.chol_inv,
-                        )
+                    prop_ops = self._proposal_operators(
+                        r_prop, chol_prop, inv_prop, phi_prop, mask,
+                        dist_cross, dist_test, cache,
                     )
-                    if cache.krige_w is not None:
-                        kw_p, kc_p = self._krige_ops(
-                            chol_prop, phi_prop, mask, dist_cross,
-                            dist_test, inv_prop,
+
+                    def sel(p, cur, extra_dims):
+                        if cur is None:
+                            return None
+                        acc_b = accept.reshape(
+                            accept.shape + (1,) * extra_dims
                         )
-                        kw_new = jnp.where(acc3, kw_p, cache.krige_w)
-                        kc_new = jnp.where(acc3, kc_p, cache.krige_chol)
-                    else:
-                        kw_new = kc_new = None
-                cache_new = SolveCache(
-                    r_mv=r_mv_new, nys_z=nys_new, chol_inv=inv_new,
-                    krige_w=kw_new, krige_chol=kc_new,
-                )
+                        return jnp.where(acc_b, p, cur)
+
+                    cache_new = SolveCache(
+                        r_mv=sel(prop_ops.r_mv, cache.r_mv, 2),
+                        nys_z=sel(prop_ops.nys_z, cache.nys_z, 2),
+                        chol_inv=sel(
+                            prop_ops.chol_inv, cache.chol_inv, 3
+                        ),
+                        krige_w=sel(prop_ops.krige_w, cache.krige_w, 2),
+                        krige_chol=sel(
+                            prop_ops.krige_chol, cache.krige_chol, 2
+                        ),
+                    )
             return (
                 jnp.where(accept, phi_prop, phi),
                 jnp.where(acc3, chol_prop, chol_cur),
@@ -551,37 +585,170 @@ class SpatialGPSampler:
         def phi_keep(_):
             return phi, state.chol_r, jnp.zeros((q,), dtype), cache
 
-        if cfg.phi_update_every == 1:
-            is_update = jnp.asarray(1.0, dtype)
-            phi, chol_r, accepted, cache = phi_mh(None)
-        else:
+        if cfg.phi_sampler == "conditional":
+            if cfg.phi_update_every == 1:
+                is_update = jnp.asarray(1.0, dtype)
+                phi, chol_r, accepted, cache = phi_mh(None)
+            else:
+                is_update = (it % cfg.phi_update_every == 0).astype(dtype)
+                phi, chol_r, accepted, cache = lax.cond(
+                    it % cfg.phi_update_every == 0, phi_mh, phi_keep,
+                    None,
+                )
+        else:  # collapsed: updated per component inside the u loop
             is_update = (it % cfg.phi_update_every == 0).astype(dtype)
-            phi, chol_r, accepted, cache = lax.cond(
-                it % cfg.phi_update_every == 0, phi_mh, phi_keep, None
-            )
-        phi_accept = state.phi_accept + accepted
+            accepted = jnp.zeros((q,), dtype)  # filled by the loop
+            chol_r = state.chol_r
 
-        # Robbins–Monro adaptation of the MH step toward the target
-        # acceptance (reference R:83), burn-in only (`collect` is False
-        # exactly for the burn-in scan); the vanishing gain and the
-        # freeze during sampling keep the sampling-phase kernel a
-        # fixed, detailed-balance-preserving Metropolis step. Skipped
-        # sweeps (is_update = 0) leave the step untouched.
-        if cfg.phi_adapt and not collect:
-            gain = cfg.phi_adapt_rate * (1.0 + it.astype(dtype)) ** -0.6
-            phi_log_step = state.phi_log_step + gain * is_update * (
-                accepted - cfg.phi_target_accept
-            )
-            phi_log_step = jnp.clip(
-                phi_log_step, jnp.log(1e-3), jnp.log(50.0)
-            )
-        else:
-            phi_log_step = state.phi_log_step
+        def rm_adapt(accepted_vec):
+            # Robbins–Monro adaptation of the MH step toward the
+            # target acceptance (reference R:83), burn-in only
+            # (`collect` is False exactly for the burn-in scan); the
+            # vanishing gain and the freeze during sampling keep the
+            # sampling-phase kernel a fixed, detailed-balance-
+            # preserving Metropolis step. Skipped sweeps
+            # (is_update = 0) leave the step untouched.
+            if cfg.phi_adapt and not collect:
+                gain = cfg.phi_adapt_rate * (
+                    1.0 + it.astype(dtype)
+                ) ** -0.6
+                new = state.phi_log_step + gain * is_update * (
+                    accepted_vec - cfg.phi_target_accept
+                )
+                return jnp.clip(new, jnp.log(1e-3), jnp.log(50.0))
+            return state.phi_log_step
+
+        if cfg.phi_sampler == "conditional":
+            phi_accept = state.phi_accept + accepted
+            phi_log_step = rm_adapt(accepted)
 
         # --- 4. U | z, beta, A, phi — per-component Matheron draw -----
         # Pseudo-obs for component j: precision c_i = sum_l womega_il
         # A_lj^2, linear term b_i = sum_l womega_il A_lj resid_il;
         # Matheron with heteroscedastic noise D = diag(1/c).
+        # With phi_sampler="collapsed", each component's phi update
+        # runs HERE, immediately before its u_j redraw: MH on the
+        # closed-form marginal ytilde ~ N(0, R_j(phi) + jit I + D)
+        # (u_j integrated out — exactly the (R + D) system the draw
+        # below solves). The [phi_j | z, beta, A, u_{-j}] move followed
+        # by [u_j | everything] is a valid partially-collapsed Gibbs
+        # block, and sequencing components keeps q > 1 valid (each
+        # phi_j conditions on the other components' CURRENT u).
+        def collapsed_phi_block(j, phi, chol_r, cache, ytilde, d_vec):
+            def upd(_):
+                phi_j = phi[j]
+                step = jnp.exp(state.phi_log_step[j])
+                t_cur = jnp.log((phi_j - lo) / (hi - phi_j))
+                eps = jax.random.normal(
+                    jax.random.fold_in(kprop, j), (), dtype
+                )
+                t_prop = t_cur + step * eps
+                sig_cur = jax.nn.sigmoid(t_cur)
+                sig_prop = jax.nn.sigmoid(t_prop)
+                phi_prop = lo + (hi - lo) * sig_prop
+                shift = jit_eff + d_vec
+
+                def marg_ll(phi_v):
+                    # the marginal's S = R~(phi) + jit I + D: pad rows
+                    # (identity correlation rows, ytilde = 0, d = big)
+                    # contribute a phi-free constant that cancels in
+                    # the ratio, so padding cannot bias phi here
+                    # either
+                    with jax.named_scope("phi_marg_chol"):
+                        r = masked_correlation(
+                            dist, phi_v, mask, cfg.cov_model
+                        )
+                        chol_s = jittered_cholesky(
+                            r + jnp.diag(shift), 0.0
+                        )
+                    alpha = self._tri(chol_s, ytilde)
+                    ll = -0.5 * jnp.sum(alpha * alpha) - 0.5 * (
+                        chol_logdet(chol_s)
+                    )
+                    return ll, r
+
+                ll_cur, _ = marg_ll(phi_j)
+                ll_prop, r_prop = marg_ll(phi_prop)
+                log_ratio = (
+                    ll_prop
+                    + jnp.log(sig_prop * (1.0 - sig_prop))
+                    - ll_cur
+                    - jnp.log(sig_cur * (1.0 - sig_cur))
+                )
+                accept = (
+                    jnp.log(
+                        jax.random.uniform(
+                            jax.random.fold_in(kphi, j), (), dtype,
+                            minval=1e-12,
+                        )
+                    )
+                    < log_ratio
+                )
+                phi_new = jnp.where(accept, phi_prop, phi_j)
+                # the carried prior factor (u* draws, kriging) must
+                # track the accepted phi — the third m^3 factorization
+                # of a collapsed update (see SMKConfig.phi_sampler)
+                with jax.named_scope("phi_chol"):
+                    chol_prop = self._chol_r(r_prop)
+                chol_j = jnp.where(accept, chol_prop, chol_r[j])
+                cache_new = cache
+                if cache is not None:
+                    # same field inventory as the conditional step's
+                    # refresh — _proposal_operators with a 1-length
+                    # component axis, then a per-slice accept-select
+                    with jax.named_scope("cache_refresh"):
+                        inv_prop_j = (
+                            panel_inverses(
+                                chol_prop, cfg.trisolve_block_size
+                            )
+                            if cache.chol_inv is not None
+                            else None
+                        )
+                        prop_ops = self._proposal_operators(
+                            r_prop[None], chol_prop[None],
+                            None
+                            if inv_prop_j is None
+                            else inv_prop_j[None],
+                            phi_prop[None], mask, dist_cross,
+                            dist_test, cache,
+                        )
+
+                        def sel_j(p, cur):
+                            if cur is None:
+                                return None
+                            return cur.at[j].set(
+                                jnp.where(accept, p[0], cur[j])
+                            )
+
+                        cache_new = SolveCache(
+                            r_mv=sel_j(prop_ops.r_mv, cache.r_mv),
+                            nys_z=sel_j(prop_ops.nys_z, cache.nys_z),
+                            chol_inv=sel_j(
+                                prop_ops.chol_inv, cache.chol_inv
+                            ),
+                            krige_w=sel_j(
+                                prop_ops.krige_w, cache.krige_w
+                            ),
+                            krige_chol=sel_j(
+                                prop_ops.krige_chol, cache.krige_chol
+                            ),
+                        )
+                return (
+                    phi.at[j].set(phi_new),
+                    chol_r.at[j].set(chol_j),
+                    cache_new,
+                    accept.astype(dtype),
+                )
+
+            def keep(_):
+                return phi, chol_r, cache, jnp.zeros((), dtype)
+
+            if cfg.phi_update_every == 1:
+                return upd(None)
+            return lax.cond(
+                it % cfg.phi_update_every == 0, upd, keep, None
+            )
+
         e0 = zbar - eta_fixed  # (m, q)
         big = jnp.asarray(cfg.mask_noise_var, dtype)
         ku_priors = jax.random.split(ku_prior, q)
@@ -596,6 +763,11 @@ class SpatialGPSampler:
             c_safe = jnp.maximum(c_vec, 1.0 / big)
             ytilde = b_vec / c_safe
             d_vec = jnp.minimum(1.0 / c_safe, big)  # noise variance
+            if cfg.phi_sampler == "collapsed":
+                phi, chol_r, cache, acc_j = collapsed_phi_block(
+                    j, phi, chol_r, cache, ytilde, d_vec
+                )
+                accepted = accepted.at[j].set(acc_j)
             l_j = chol_r[j]
             # prior draw u* = L xi  and noise draw eta* = sqrt(d) xi2
             u_star = l_j @ jax.random.normal(ku_priors[j], (m,), dtype)
@@ -647,12 +819,22 @@ class SpatialGPSampler:
                 # distance matrix — O(m^2), not the O(m^3) L @ L^T.
                 # The jitter enters once, here (it is part of the
                 # prior covariance the carried chol_r factors).
+                # Known redundancy under phi_sampler="collapsed": on
+                # update sweeps this refactorizes the S the collapsed
+                # block just factored (threading the selected factor
+                # through the cond is not worth the plumbing — the
+                # dense path is the small-m option, u_solver="cg" is
+                # the scaling path).
                 r_mat = masked_correlation(
                     dist, phi[j], mask, cfg.cov_model
                 ) + jit_eff * jnp.eye(m, dtype=dtype)
                 chol_m = jittered_cholesky(r_mat + jnp.diag(d_vec), 0.0)
                 s = chol_solve(chol_m, rhs_vec)
                 u = u.at[:, j].set(u_star + r_mat @ s)
+
+        if cfg.phi_sampler == "collapsed":
+            phi_accept = state.phi_accept + accepted
+            phi_log_step = rm_adapt(accepted)
 
         # --- 5. A | z, beta, U (lower-triangular coregionalization) ---
         # Row l of A only multiplies components j <= l (w_l = U_{:,:l+1}

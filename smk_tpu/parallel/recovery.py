@@ -136,12 +136,21 @@ def _leaf_fingerprint(leaf) -> int:
     h = zlib.crc32(repr((jnp.shape(leaf), str(arr.dtype))).encode())
     if n == 0:
         return h
-    if arr.dtype.itemsize == 4:
+    itemsize = arr.dtype.itemsize
+    if itemsize == 4:
         bits = jax.lax.bitcast_convert_type(arr, jnp.uint32)
-    else:
-        bits = jax.lax.bitcast_convert_type(
-            arr.astype(jnp.float32), jnp.uint32
+    elif itemsize == 8:
+        # two uint32 words per element — a float64/int64 leaf changed
+        # below fp32 precision must still move the checksum (casting
+        # through float32 would round the perturbation away and allow
+        # a silent resume onto slightly-changed data)
+        bits = jax.lax.bitcast_convert_type(arr, jnp.uint32).reshape(-1)
+    elif itemsize == 2:
+        bits = jax.lax.bitcast_convert_type(arr, jnp.uint16).astype(
+            jnp.uint32
         )
+    else:  # 1-byte dtypes (bool/int8): the value determines the bits
+        bits = arr.astype(jnp.uint32)
     h = zlib.crc32(np.asarray(_leaf_checksum(bits)).tobytes(), h)
     stride = max(1, n // _IDENT_SAMPLE)
     sample = np.asarray(arr[::stride][:_IDENT_SAMPLE])

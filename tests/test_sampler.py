@@ -516,3 +516,113 @@ class TestKrigeCache:
             it += ln
         assert jnp.array_equal(jnp.concatenate(pds), one[1][0])
         assert jnp.array_equal(jnp.concatenate(wds), one[1][1])
+
+
+class TestCollapsedPhiSampler:
+    """phi_sampler="collapsed" — MH on the closed-form marginal
+    ytilde ~ N(0, R(phi) + jit I + D) with u_j integrated out, run as
+    a partially-collapsed block immediately before each u_j redraw.
+    Checks: (a) it targets the SAME posterior as the conditional
+    sampler (agreement within MC error on an informative q=1 field),
+    (b) it mixes phi strictly better at equal update count (the whole
+    point — the conditional's u-phi coupling throttles ESS), (c) all
+    link/solver paths run finite, (d) chunked sampling stays
+    bit-exact (the kill/resume invariant under the per-component
+    cache refresh)."""
+
+    def _field(self, m=150, seed=42):
+        key = jax.random.key(seed)
+        kc, ku, ky, kx = jax.random.split(key, 4)
+        coords = jax.random.uniform(kc, (m, 2))
+        dist = pairwise_distance(coords)
+        l = jittered_cholesky(exponential(dist, 7.0), 1e-5)
+        u = l @ jax.random.normal(ku, (m,))
+        x = jnp.concatenate(
+            [jnp.ones((m, 1, 1)), jax.random.normal(kx, (m, 1, 1))], -1
+        )
+        eta = jnp.einsum(
+            "mqp,qp->mq", x, jnp.asarray([[0.8, -0.5]])
+        ) + u[:, None]
+        y = (
+            jax.random.uniform(ky, eta.shape)
+            < jax.scipy.special.ndtr(eta)
+        ).astype(jnp.float32)
+        return SubsetData(
+            coords, x, y, jnp.ones((m,)), coords[:4] + 0.01, x[:4]
+        )
+
+    def test_same_posterior_better_mixing(self):
+        from smk_tpu.utils.diagnostics import effective_sample_size
+
+        data = self._field()
+        out = {}
+        for sampler in ("conditional", "collapsed"):
+            cfg = SMKConfig(
+                n_samples=1600, burn_in_frac=0.5, phi_update_every=2,
+                phi_sampler=sampler, u_solver="chol",
+                priors=PriorConfig(a_prior="invwishart"),
+            )
+            model = SpatialProbitGP(cfg, weight=1)
+            chains = []
+            for seed in (5, 6):
+                st = model.init_state(jax.random.key(seed), data)
+                chains.append(
+                    np.asarray(jax.jit(model.run)(data, st).param_samples)
+                )
+            pooled = np.concatenate(chains)
+            ess = float(
+                effective_sample_size(jnp.asarray(chains[0][:, 3]))
+            )
+            out[sampler] = (pooled, ess)
+        pc, ess_c = out["conditional"]
+        pm, ess_m = out["collapsed"]
+        # posterior agreement within MC error (phi is the slow one)
+        for col, tol_sd in ((0, 0.5), (1, 0.5), (3, 0.5)):
+            gap = abs(pc[:, col].mean() - pm[:, col].mean())
+            sd = max(pc[:, col].std(), 1e-6)
+            assert gap < tol_sd * sd, (col, gap, sd)
+        # the collapsed sampler must mix phi materially better at the
+        # SAME update count (measured 13 vs 91 at this config; the
+        # margin is kept loose for MC noise)
+        assert ess_m > 2.0 * ess_c, (ess_c, ess_m)
+
+    @pytest.mark.parametrize(
+        "link,u_solver", [("probit", "cg"), ("logit", "cg"),
+                          ("probit", "chol")]
+    )
+    def test_runs_finite_all_paths(self, link, u_solver):
+        data, _ = synthetic_subset(
+            jax.random.key(31), 96, 2, 2,
+            [5.0, 9.0], [[1.0, 0.0], [0.4, 0.9]],
+            [[0.6, -0.4], [0.3, 0.7]],
+        )
+        cfg = SMKConfig(
+            n_subsets=1, n_samples=80, burn_in_frac=0.5,
+            phi_update_every=2, phi_sampler="collapsed", link=link,
+            u_solver=u_solver, cg_iters=24, trisolve_block_size=32,
+            cg_precond="nystrom", cg_precond_rank=48,
+        )
+        model = SpatialProbitGP(cfg, weight=1)
+        st = model.init_state(jax.random.key(5), data)
+        res = jax.jit(model.run)(data, st)
+        assert np.isfinite(np.asarray(res.param_samples)).all()
+        assert np.isfinite(np.asarray(res.w_samples)).all()
+        acc = np.asarray(res.phi_accept_rate)
+        assert (acc > 0.01).all() and (acc <= 1.0).all(), acc
+
+    def test_chunked_matches_one_shot(self):
+        data = self._field(m=80, seed=7)
+        cfg = SMKConfig(
+            n_subsets=1, n_samples=60, burn_in_frac=0.5,
+            phi_update_every=2, phi_sampler="collapsed",
+            u_solver="cg", cg_iters=24, trisolve_block_size=32,
+        )
+        model = SpatialProbitGP(cfg, weight=1)
+        st = model.burn_in(data, model.init_state(jax.random.key(5), data))
+        one = model.sample_chunk(data, st, jnp.asarray(cfg.n_burn_in), 30)
+        s, it, pds = st, cfg.n_burn_in, []
+        for ln in (10, 20):
+            s, (pd, _) = model.sample_chunk(data, s, jnp.asarray(it), ln)
+            pds.append(pd)
+            it += ln
+        assert jnp.array_equal(jnp.concatenate(pds), one[1][0])
