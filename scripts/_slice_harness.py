@@ -33,7 +33,7 @@ def make_slice_data(m, k, q, t, seed=0):
 
 
 def bench_solver_config(k):
-    """The r3 bench solver defaults (bench.py run_rung) — change BOTH
+    """The bench solver defaults (bench.py rung_config) — change BOTH
     there and here, or the committed evidence artifacts stop
     describing the benched program."""
     return SMKConfig(
@@ -45,7 +45,8 @@ def bench_solver_config(k):
         cg_precond="nystrom",
         cg_precond_rank=256,
         cg_matvec_dtype="bfloat16",
-        phi_update_every=4,
+        phi_update_every=16,
+        phi_sampler="collapsed",
         trisolve_block_size=512,
         priors=PriorConfig(a_prior="invwishart"),
     )

@@ -56,6 +56,11 @@ def main():
         "BENCH_USOLVER": ("u_solver", str),
         "BENCH_CHOL_BLOCK": ("chol_block_size", int),
         "BENCH_TRI_BLOCK": ("trisolve_block_size", int),
+        "BENCH_PHI_SAMPLER": ("phi_sampler", str),
+        # "0"/"1": probe the r5 cached kriging operators off/on
+        "BENCH_KRIGE_CACHE": (
+            "krige_cache", lambda s: bool(int(s))
+        ),
     }
     over = {
         field: conv(os.environ[name])

@@ -41,10 +41,24 @@ carries its own criterion (the K artifact is fixable by tempering,
 phi's subset-information gap is not — a flat prior has no mass to
 temper).
 
-Run on TPU (prints one JSON line to stdout; one line per QUAL_N):
-    python scripts/smk_quality.py >  SMK_QUALITY_r04.jsonl
-    QUAL_N=8000 python scripts/smk_quality.py >> SMK_QUALITY_r04.jsonl
-Commit SMK_QUALITY_r04.jsonl (the name BASELINE.md cites).
+Since r5 the study covers the reference's ACTUAL model class — q=2
+multivariate binary responses with a learned coregionalization
+(MetaKriging_BinaryResponse.R:80-81,56,64) — via QUAL_Q=2: the
+generator becomes a true LMC field (two independent component GPs at
+distinct ranges mixed by a lower-triangular A_true), QUAL_LINK picks
+probit or the reference's own logit, every K[i,j] column (including
+the cross-covariance K[1,0]) enters the tempered criterion exactly as
+K00 always did (k_ix spans the whole lower triangle by name), and the
+q=2 p(y=1) SURFACE — the reference's end product (R:156-161) — gets
+its own absolute-units criterion from the public
+predict-probability path.
+
+Run on TPU (prints one JSON line to stdout; one line per invocation):
+    QUAL_Q=2 QUAL_LINK=logit  python scripts/smk_quality.py >> SMK_QUALITY_r05.jsonl
+    QUAL_Q=2 QUAL_LINK=logit  QUAL_N=8000 python scripts/smk_quality.py >> SMK_QUALITY_r05.jsonl
+    QUAL_Q=2 QUAL_LINK=probit python scripts/smk_quality.py >> SMK_QUALITY_r05.jsonl
+    QUAL_Q=2 QUAL_LINK=probit QUAL_N=8000 python scripts/smk_quality.py >> SMK_QUALITY_r05.jsonl
+Commit the output file (r4's q=1 rows stand in SMK_QUALITY_r04.jsonl).
 """
 
 import json
@@ -66,6 +80,52 @@ N = int(os.environ.get("QUAL_N", 4000))
 K_META = int(os.environ.get("QUAL_K", 8))
 N_TEST = 64
 N_SAMPLES = int(os.environ.get("QUAL_SAMPLES", 5000))
+Q = int(os.environ.get("QUAL_Q", 1))
+LINK = os.environ.get("QUAL_LINK", "probit")
+# the generator's ground truth for the q=2 LMC arm: distinct ranges
+# per component and a genuinely non-diagonal mixing A (K[1,0] != 0)
+PHIS_TRUE = (6.0, 9.0)
+A_TRUE = ((1.0, 0.0), (0.6, 0.8))
+
+
+def make_lmc_binary_field(key, n, q, p=2, link="probit",
+                          n_features=256):
+    """LMC binary field via per-component random Fourier features:
+    q independent unit GPs u_j at ranges PHIS_TRUE mixed by A_TRUE
+    (w = U A^T — the model class the sampler fits and the reference
+    assumes, R:56,64), then a binomial draw through `link`."""
+    kc, kx, ky = jax.random.split(key, 3)
+    coords = jax.random.uniform(kc, (n, 2), jnp.float32)
+    us = []
+    for j in range(q):
+        kw, kb, kcoef = jax.random.split(jax.random.fold_in(key, 100 + j), 3)
+        freqs = PHIS_TRUE[j] * jax.random.cauchy(
+            kw, (n_features, 2), jnp.float32
+        )
+        phase = jax.random.uniform(
+            kb, (n_features,), jnp.float32, 0, 2 * np.pi
+        )
+        feats = jnp.sqrt(2.0 / n_features) * jnp.cos(
+            coords @ freqs.T + phase
+        )
+        us.append(feats @ jax.random.normal(kcoef, (n_features,)))
+    u = jnp.stack(us, axis=-1)  # (n, q)
+    w = u @ jnp.asarray(A_TRUE, jnp.float32)[:q, :q].T
+    x = jnp.concatenate(
+        [jnp.ones((n, q, 1), jnp.float32),
+         jax.random.normal(kx, (n, q, p - 1), jnp.float32)], -1
+    )
+    beta = jnp.asarray(
+        np.linspace(0.8, -0.6, q * p).reshape(q, p), jnp.float32
+    )
+    eta = jnp.einsum("nqp,qp->nq", x, beta) + w
+    p1 = (
+        jax.scipy.special.ndtr(eta)
+        if link == "probit"
+        else jax.nn.sigmoid(eta)
+    )
+    y = (jax.random.uniform(ky, eta.shape) < p1).astype(jnp.float32)
+    return y, x, coords
 
 
 def fit(k, y, x, coords, ct, xt, temper="none"):
@@ -73,6 +133,7 @@ def fit(k, y, x, coords, ct, xt, temper="none"):
         n_subsets=k,
         n_samples=N_SAMPLES,
         cov_model="exponential",
+        link=LINK,
         u_solver="cg",
         cg_iters=8,
         cg_precond="nystrom",
@@ -91,7 +152,14 @@ def fit(k, y, x, coords, ct, xt, temper="none"):
 
 
 def main():
-    y, x, coords = make_binary_field(jax.random.key(9), N + N_TEST, q=1, p=2)
+    if Q == 1:
+        y, x, coords = make_binary_field(
+            jax.random.key(9), N + N_TEST, q=1, p=2
+        )
+    else:
+        y, x, coords = make_lmc_binary_field(
+            jax.random.key(9), N + N_TEST, Q, link=LINK
+        )
     y, x, coords, ct, xt = (
         y[:N], x[:N], coords[:N], coords[N:], x[N:],
     )
@@ -107,7 +175,17 @@ def main():
     pg_full = np.asarray(res_full.param_grid)  # (200, d)
     pg_meta = np.asarray(res_meta.param_grid)
     pg_temp = np.asarray(res_temp.param_grid)
-    names = param_names(1, 2)
+    names = param_names(Q, 2)
+
+    # the reference's end product (R:156-161): the p(y=1) surface at
+    # the test sites, through the public predict path — compared in
+    # ABSOLUTE probability units (the only scale-free unit for a
+    # probability; q=2 columns span both responses)
+    p_med_full = np.asarray(res_full.p_quant)[0]
+    p_med_meta = np.asarray(res_meta.p_quant)[0]
+    p_med_temp = np.asarray(res_temp.p_quant)[0]
+    p_gap = float(np.max(np.abs(p_med_meta - p_med_full)))
+    p_gap_t = float(np.max(np.abs(p_med_temp - p_med_full)))
 
     # full-posterior spread from its own quantile grid (IQR/1.349
     # is a robust sd; the grid rows are the quantile function)
@@ -148,6 +226,7 @@ def main():
     phi_ix = [i for i, n_ in enumerate(names) if n_.startswith("phi[")]
     out = {
         "n": N, "k_meta": K_META, "iters": N_SAMPLES,
+        "q": Q, "link": LINK,
         "m_subset": -(-N // K_META),
         "fit_s": {"full_k1": round(t_full, 1),
                   f"meta_k{K_META}": round(t_meta, 1),
@@ -188,11 +267,23 @@ def main():
         # prior-counted-K-times mechanism inherent to the published
         # method; the tempered arm is the fix and carries its own
         # criterion below (VERDICT r3 #4).
+        "p_surface_max_abs_gap": round(p_gap, 4),
+        "p_surface_max_abs_gap_tempered": round(p_gap_t, 4),
         "pass": bool(
             # slope columns located by name, not a hardcoded slice —
             # survives a q/p change in the generator call above
             float(np.max(gap_cal[slope_ix])) < 2.0
             and float(np.mean(w2_w_rel)) < 2.0
+            # the p(y=1) surface must agree in absolute probability
+            # units — the end product the reference hands its user
+            and p_gap < 0.15
+        ),
+        # the r4 advisor asked for the pre-relaxation threshold to
+        # stay visible in the evidence: same meta-sd unit, 1.5 gate
+        "pass_strict_meta_sd_1p5": bool(
+            float(np.max(gap_cal[slope_ix])) < 1.5
+            and float(np.mean(w2_w_rel)) < 2.0
+            and p_gap < 0.15
         ),
         # tempered criterion: the artifact tempering CAN fix is the
         # prior-counted-K-times shrinkage, which only bites priors

@@ -135,8 +135,14 @@ def main():
         "C_coll_phi8": ("collapsed", 8, N_SAMPLES, 7),
         # independent-seed baseline replica: its gap vs arm A is pure
         # MC noise and must sit inside the same 4-SE criterion the
-        # candidates are judged by (calibrates the SE model in situ)
+        # candidates are judged by (calibrates the SE model in situ —
+        # the first run measured the replica itself at 11.7 SE, so
+        # pass/fail is also scored RELATIVE to the replica below)
         "D_cond_phi4_rep": ("conditional", 4, N_SAMPLES, 11),
+        # sparser-than-budget-parity candidate: 3/16 < 1/4 of the
+        # baseline's per-sweep Cholesky budget — a wall-clock WIN if
+        # its phi ESS holds at or above the baseline's
+        "E_coll_phi16": ("collapsed", 16, N_SAMPLES, 7),
     }
     results = {}
     for name, (sampler, every, n, seed) in arms.items():
@@ -164,6 +170,7 @@ def main():
             for name, r in results.items()
         },
     }
+    g_rep, g_se_rep = gaps_and_se(base, results["D_cond_phi4_rep"]["ps"])
     for name, r in results.items():
         if name == "A_cond_phi4":
             continue
@@ -174,6 +181,15 @@ def main():
         }
         out[f"{name}_max_gap_in_se"] = round(float(g_se.max()), 3)
         out[f"{name}_pass"] = bool(g_se.max() < 4.0 and g.mean() < 0.4)
+        if name != "D_cond_phi4_rep":
+            # the in-situ-calibrated criterion: a candidate whose
+            # worst gap is no larger than what PURE MC NOISE produced
+            # between two independent baseline chains cannot be
+            # distinguished from the baseline by this protocol
+            out[f"{name}_pass_vs_replica"] = bool(
+                g_se.max() <= max(float(g_se_rep.max()), 4.0)
+                and g.max() <= max(float(g_rep.max()), 1.0)
+            )
     emit(out)
 
 

@@ -607,10 +607,16 @@ class SpatialGPSampler:
             # vanishing gain and the freeze during sampling keep the
             # sampling-phase kernel a fixed, detailed-balance-
             # preserving Metropolis step. Skipped sweeps
-            # (is_update = 0) leave the step untouched.
+            # (is_update = 0) leave the step untouched. The gain
+            # clock counts UPDATES, not sweeps — with a sparse
+            # phi_update_every an iteration-indexed clock decays the
+            # gain e-fold faster than adaptation events arrive and
+            # the step freezes far from target (measured: collapsed
+            # phi/12 at m=1953 stuck at 0.71 acceptance vs the 0.43
+            # target under the old clock).
             if cfg.phi_adapt and not collect:
                 gain = cfg.phi_adapt_rate * (
-                    1.0 + it.astype(dtype)
+                    1.0 + it.astype(dtype) / cfg.phi_update_every
                 ) ** -0.6
                 new = state.phi_log_step + gain * is_update * (
                     accepted_vec - cfg.phi_target_accept
@@ -667,8 +673,21 @@ class SpatialGPSampler:
                     )
                     return ll, r
 
+                # The three m^2 workspaces of a collapsed update
+                # (S_cur, S_prop, R_prop factor chains) must NOT be
+                # live at once: XLA schedules the two marg_ll chains
+                # concurrently and the resulting peak exceeds v5e HBM
+                # by ~300 MB at the config-5 slice (measured OOM).
+                # The barriers sequence cur -> prop -> refresh so each
+                # chain's temporaries die before the next allocates.
                 ll_cur, _ = marg_ll(phi_j)
+                ll_cur, phi_prop = lax.optimization_barrier(
+                    (ll_cur, phi_prop)
+                )
                 ll_prop, r_prop = marg_ll(phi_prop)
+                ll_prop, r_prop = lax.optimization_barrier(
+                    (ll_prop, r_prop)
+                )
                 log_ratio = (
                     ll_prop
                     + jnp.log(sig_prop * (1.0 - sig_prop))
