@@ -703,12 +703,24 @@ class SpatialGPSampler:
                     )
                     < log_ratio
                 )
-                phi_new = jnp.where(accept, phi_prop, phi_j)
                 # the carried prior factor (u* draws, kriging) must
                 # track the accepted phi — the third m^3 factorization
                 # of a collapsed update (see SMKConfig.phi_sampler)
                 with jax.named_scope("phi_chol"):
                     chol_prop = self._chol_r(r_prop)
+                # fp32 guard: the marginal ratio factors the WELL-
+                # conditioned S = R + jit I + D, so it can accept a
+                # phi whose bare R + jit I factorization fails on
+                # near-duplicate locations (measured: eBird Thomas-
+                # cluster subsets at m=1024 — a NaN factor entered
+                # the carry and killed the chain). The conditional
+                # sampler is implicitly protected because its ratio
+                # IS that factorization (NaN ratio -> reject); the
+                # collapsed accept must impose the same rejection.
+                accept = accept & jnp.all(
+                    jnp.isfinite(jnp.diagonal(chol_prop))
+                )
+                phi_new = jnp.where(accept, phi_prop, phi_j)
                 chol_j = jnp.where(accept, chol_prop, chol_r[j])
                 cache_new = cache
                 if cache is not None:

@@ -1,0 +1,98 @@
+"""Within-subset sharded factorization — SURVEY.md §5.7's contingency.
+
+The K-way partition is the framework's long-axis (n) scaling device:
+no north-star shape needs more than one chip per subset (m=3906 is
+~61 MB of fp32 correlation). But SURVEY §5.7 names the fallback for
+subsets that outgrow a chip — shard ONE subset's (q·m)x(q·m)
+factorization across the mesh — and this module makes that path
+real: the m x m correlation lives row-sharded over the mesh axis and
+never materializes on one device.
+
+Design: XLA's native `lax.linalg.cholesky` does not SPMD-partition —
+GSPMD replicates the operand, which defeats the purpose. The
+blocked left-looking form (ops/chol.py blocked_cholesky) is almost
+entirely large GEMMs (the Schur-complement update and the
+panel-inverse scale), and GEMMs are exactly what GSPMD partitions
+well: with the operand sharded P(axis, None), each block column's
+update is a (m-k·b, b) x (b, b) contraction whose long axis stays
+sharded, the b x b diagonal factorization is replicated (tiny), and
+XLA inserts the all-gathers for the (row-block, column-panel)
+operands. The same layout serves the CG path: a row-sharded m x m
+matvec partitions into per-device (m/d, m) x (m,) contractions with
+one all-gather of the vector.
+
+What is validated (tests/test_sharded_chol.py, 8-device CPU mesh):
+numerical agreement with the single-device factorization, execution
+with genuinely sharded inputs/outputs (the factor comes back with
+the requested sharding), and the matvec/CG round trip. No
+performance claim is made or needed at north-star scale — this
+closes the blueprint's capability row, sized for the day a subset
+exceeds one chip.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from smk_tpu.ops.chol import blocked_cholesky
+
+
+def row_sharding(mesh: Mesh, axis: Optional[str] = None) -> NamedSharding:
+    """Rows over the mesh axis, columns replicated — the layout every
+    op here assumes."""
+    return NamedSharding(mesh, P(axis or mesh.axis_names[0], None))
+
+
+def sharded_cholesky(
+    mat: jnp.ndarray,
+    mesh: Mesh,
+    *,
+    jitter: float = 0.0,
+    block_size: int = 512,
+    axis: Optional[str] = None,
+) -> jnp.ndarray:
+    """Lower Cholesky factor of a row-sharded SPD matrix.
+
+    ``mat`` is placed (if not already) with rows sharded over the
+    mesh axis; the blocked-GEMM factorization runs under those
+    shardings and the factor is returned row-sharded. Same numerics
+    as the single-device blocked form (fp32 reassociation only).
+
+    m must not be smaller than block_size * devices for the sharding
+    to be meaningful (smaller inputs work but degenerate to mostly
+    replicated compute).
+    """
+    shard = row_sharding(mesh, axis)
+    mat = jax.device_put(mat, shard)
+    fn = jax.jit(
+        lambda a: blocked_cholesky(a, jitter, block_size),
+        in_shardings=shard,
+        out_shardings=shard,
+    )
+    return fn(mat)
+
+
+def sharded_matvec(
+    mat: jnp.ndarray, vec: jnp.ndarray, mesh: Mesh,
+    *, axis: Optional[str] = None,
+) -> jnp.ndarray:
+    """y = mat @ vec with mat row-sharded: each device contracts its
+    row block against the (replicated) vector — zero communication on
+    the matrix, one tiny gather on the output. The building block for
+    a sharded-subset CG u-solve (ops/cg.py cg_solve is
+    layout-agnostic: pass ``lambda v: sharded_matvec(mat, v, mesh)``
+    as the operator)."""
+    shard = row_sharding(mesh, axis)
+    repl = NamedSharding(mesh, P())
+    mat = jax.device_put(mat, shard)
+    vec = jax.device_put(vec, repl)
+    fn = jax.jit(
+        lambda a, v: a @ v,
+        in_shardings=(shard, repl),
+        out_shardings=NamedSharding(mesh, P(axis or mesh.axis_names[0])),
+    )
+    return fn(mat, vec)

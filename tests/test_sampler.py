@@ -626,3 +626,26 @@ class TestCollapsedPhiSampler:
             pds.append(pd)
             it += ln
         assert jnp.array_equal(jnp.concatenate(pds), one[1][0])
+
+    def test_failed_proposal_factorization_never_accepted(self):
+        """fp32 guard: the collapsed ratio factors the well-
+        conditioned S = R + jit I + D, so it could accept a phi whose
+        bare R + jit I factorization fails (measured on eBird Thomas-
+        cluster subsets — a NaN factor entered the carry). With every
+        proposal factorization forced to fail, the guard must reject
+        every move and the chain must stay finite."""
+        data = self._field(m=60, seed=3)
+        cfg = SMKConfig(
+            n_subsets=1, n_samples=40, burn_in_frac=0.5,
+            phi_update_every=2, phi_sampler="collapsed",
+            u_solver="cg", cg_iters=16,
+        )
+        model = SpatialProbitGP(cfg, weight=1)
+        st = model.init_state(jax.random.key(5), data)
+        model._chol_r = lambda r: jnp.full_like(r, jnp.nan)
+        res = jax.jit(model.run)(data, st)
+        assert np.isfinite(np.asarray(res.param_samples)).all()
+        # phi never moved: every proposal's prior factor was NaN
+        assert float(np.asarray(res.phi_accept_rate).max()) == 0.0
+        phis = np.asarray(res.param_samples)[:, -1]
+        assert np.allclose(phis, phis[0])
