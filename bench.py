@@ -381,8 +381,8 @@ def rung_data(name_seed, *, n, q, p, n_test, make_data, link, env, k,
     return cfg, model, part, coords_test, x_test, beta0, q, p
 
 
-def rung_diagnostics(record, res, cfg, *, m, k, q, n_samples, n_test,
-                     fit_s, coords0, mask0, t0):
+def rung_diagnostics(record, res, cfg, *, m, k, q, p_dim, n_samples,
+                     n_test, fit_s, coords0, mask0, t0):
     """Post-fit extras shared by both rung runners — ESS/R-hat from
     the public SubsetResult fields, the analytic op model, and the
     measured CG residual. Failures must not discard the measured
@@ -394,15 +394,23 @@ def rung_diagnostics(record, res, cfg, *, m, k, q, n_samples, n_test,
         ).all(axis=(1, 2))
         # where(ok) not multiply: a failed subset's ESS/R-hat can be
         # NaN, and 0 * NaN = NaN
+        rhat_ok = jnp.where(ok[:, None], r.param_rhat, 1.0)
         return (
             jnp.sum(jnp.where(ok[:, None], r.w_ess, 0.0)),
             jnp.sum(jnp.where(ok[:, None], r.param_ess, 0.0)),
-            jnp.max(jnp.where(ok[:, None], r.param_rhat, 1.0)),
+            jnp.max(rhat_ok),
+            # which PARAMETER carries the worst R-hat (max over
+            # subsets per column, argmax over columns) — names the
+            # convergence offender in every record (config3's 1.45
+            # is uninterpretable without it)
+            jnp.argmax(jnp.max(rhat_ok, axis=0)),
             jnp.sum(~ok),
         )
 
     try:
-        ess_total, ess_par, rhat_max, n_failed = (
+        from smk_tpu.api import param_names
+
+        ess_total, ess_par, rhat_max, rhat_arg, n_failed = (
             float(v) for v in diagnostics(res)
         )
         flops, bytes_, parts = op_model(
@@ -417,6 +425,13 @@ def rung_diagnostics(record, res, cfg, *, m, k, q, n_samples, n_test,
             "latent_ess_per_sec": round(ess_total / fit_s, 1),
             "param_ess_per_sec": round(ess_par / fit_s, 1),
             "param_rhat_max": round(rhat_max, 3),
+            # None, not a name, when every subset failed — the fill
+            # values would otherwise read as a measured parameter
+            "param_rhat_argmax": (
+                param_names(q, p_dim)[int(rhat_arg)]
+                if int(n_failed) < k
+                else None
+            ),
             "phi_accept": round(
                 float(jnp.mean(res.phi_accept_rate)), 3
             ),
@@ -591,7 +606,7 @@ def run_rung_public(name, *, n, k, cov_model, n_samples, q=1, p=2,
         "fit_s_at_best_rate": round(min(rates) * n_samples / 1e3, 1),
     }
     return rung_diagnostics(
-        record, res, cfg, m=m, k=k, q=q, n_samples=n_samples,
+        record, res, cfg, m=m, k=k, q=q, p_dim=p, n_samples=n_samples,
         n_test=n_test, fit_s=fit_s, coords0=part.coords[0],
         mask0=part.mask[0], t0=time.time(),
     )
@@ -791,7 +806,7 @@ def run_rung(name, *, n, k, cov_model, n_samples, q=1, p=2, n_test=64,
     # rung_diagnostics — fallible post-fit extras that must not
     # discard the already-measured fit_s
     return rung_diagnostics(
-        record, res, cfg, m=m, k=k, q=q, n_samples=n_samples,
+        record, res, cfg, m=m, k=k, q=q, p_dim=p, n_samples=n_samples,
         n_test=n_test, fit_s=fit_s, coords0=data.coords[0],
         mask0=data.mask[0], t0=time.time(),
     )
