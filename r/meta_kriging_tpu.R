@@ -66,6 +66,13 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
   # "invwishart" is the reference's own K.IW(q, 0.1 I)
   # (MetaKriging_BinaryResponse.R:64) and the default; "normal" is
   # the pure-conjugate N(0, a_scale^2)-rows-on-A alternative.
+  # Prior tempering (config.overrides = list(priors =
+  # smk$PriorConfig(temper = "power"))) is validated for SINGLE-
+  # response fits only: at q >= 2 the 1/K-powered IW prior under-
+  # identifies the coregionalization scale K (meta-vs-full gaps of
+  # 2-4 posterior sd, SMK_QUALITY_r05.jsonl) — the Python backend
+  # emits a warning when a q >= 2 fit is tempered; leave temper =
+  # "none" (the default) for multivariate data.
   # n.report: if set, progress is printed every n.report iterations
   # (the reference's n.report batch printouts, R:84) — the fit then
   # runs through the chunked executor. checkpoint.path: if set, the

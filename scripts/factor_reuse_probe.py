@@ -1,0 +1,77 @@
+"""Factor-reuse protocol: before/after m x m factorization counts.
+
+Runs the ISSUE-1 acceptance measurement (bench.py measure_factor_reuse
+— the shared implementation) on the CPU default-config collapsed
+sampler for the dense and CG latent solvers at q=1 and q=2, and writes
+one JSONL line per cell to FACTOR_REUSE_<tag>.jsonl:
+
+- ``per_sweep_protocol``: the implied per-sweep costs — an accepted
+  collapsed-phi update sweep performs 3 m^3 factorizations instead of
+  4 (the dense u-draw's double factorization at the old
+  probit_gp.py:853-858 is gone) and a rejected one performs 2 instead
+  of 4 (zero cache rebuilds on reject);
+- ``counts_match_protocol``: the measured per-subset FactorCache
+  counter totals match the closed-form totals those per-sweep numbers
+  imply, for every subset (so the claim pins every sweep, not a
+  mean);
+- ``accept_sequence_match``: the factor_reuse=True and =False runs
+  accept the same phi moves — necessary for bit-identical chains,
+  not sufficient (the full bitwise check on kept draws lives in
+  tests/test_factor_reuse.py). Counts are LOGICAL: under a vmapped K
+  axis the accept cond lowers to a select, so rejected lanes still
+  physically compute the accept arm there; the wall-clock reject
+  saving is real on unbatched programs (one subset per device — the
+  CPU default and the per-subset shard).
+
+Usage:  JAX_PLATFORMS=cpu python scripts/factor_reuse_probe.py [tag]
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from bench import measure_factor_reuse  # noqa: E402
+
+
+def main():
+    tag = sys.argv[1] if len(sys.argv) > 1 else "r06"
+    out_path = os.path.join(REPO, f"FACTOR_REUSE_{tag}.jsonl")
+    cells = [
+        # the CPU default config (dense exact solver) — the
+        # acceptance cell: the double factorization lived here
+        dict(q=1, u_solver="chol"),
+        dict(q=2, u_solver="chol"),
+        # the scaling-regime solver: no u-draw factorization to
+        # remove, but rejects still drop from 3 to 2
+        dict(q=1, u_solver="cg"),
+        dict(q=2, u_solver="cg"),
+    ]
+    records = []
+    for cell in cells:
+        t0 = time.time()
+        rec = measure_factor_reuse(n=512, k=4, n_iters=24,
+                                   phi_update_every=2, **cell)
+        rec["wall_s"] = round(time.time() - t0, 1)
+        records.append(rec)
+        print(json.dumps(rec), flush=True)
+    with open(out_path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    print(f"wrote {out_path}")
+    bad = [
+        r for r in records
+        if not (r["counts_match_protocol"] and r["accept_sequence_match"])
+    ]
+    if bad:
+        raise SystemExit(
+            f"protocol mismatch in {[r['u_solver'] for r in bad]}"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -209,6 +209,10 @@ def fit_meta_kriging(
             "a single response is y[:, None]"
         )
     n, q = y.shape
+    # temper="power" is validated at q=1 only (SMK_QUALITY_r05.jsonl:
+    # all four q=2 cells fail the tempered quality gate) — warn here,
+    # the first point in the pipeline where q is known
+    cfg.warn_if_tempered_multivariate(q)
     if x.ndim != 3 or x.shape[:2] != (n, q):
         raise ValueError(
             f"x must be (n={n}, q={q}, p) designs, got shape {x.shape}"

@@ -133,6 +133,19 @@ class SMKConfig:
     # deterministic-scan schedule). Each phi update costs the one
     # remaining O(m^3) Cholesky per component; raising this trades phi
     # mixing for wall-clock at large m.
+    #
+    # BEHAVIOR CHANGE (round 5, kept): the Robbins–Monro step
+    # adaptation's gain clock counts phi UPDATES, not sweeps — the
+    # gain divides the iteration index by phi_update_every
+    # (models/probit_gp.py rm_adapt). With phi_update_every > 1 this
+    # deliberately changes the adaptation trajectory relative to
+    # rounds <= 4 (under the old sweep clock the gain decayed e-fold
+    # faster than adaptation events arrived and the step froze far
+    # from target — measured: collapsed phi/12 at m=1953 stuck at
+    # 0.71 acceptance vs the 0.43 target). Conditional-sampler
+    # evidence recorded before round 5 under phi_update_every > 1 is
+    # NOT reproducible under the new clock; re-measure rather than
+    # assume.
     phi_update_every: int = 1
 
     # HOW phi is Metropolis-updated:
@@ -158,7 +171,35 @@ class SMKConfig:
     #   precedes the u_j redraw from its full conditional (a
     #   partially-collapsed Gibbs block); for q > 1, components are
     #   updated sequentially inside the u loop.
+    #   Memory note for q >= 2 at large m: each component's collapsed
+    #   update carries ~3 m^2 fp32 workspaces (the S_cur / S_prop /
+    #   R_prop factor chains, barrier-sequenced so they are never live
+    #   at once — a q=1 config-5 slice already needed that sequencing
+    #   to fit v5e HBM). The per-component loop is a lax.scan, so
+    #   COMPILE size and the scan-body working set are q-independent,
+    #   but the carried (q, m, m) chol_r/r_mv buffers still scale
+    #   linearly with q — at m ~ 3906, every extra component costs
+    #   ~61 MB per carried (m, m) buffer per subset; budget K and
+    #   chunk_size accordingly (q > 2 at north-star m is untested
+    #   headroom).
     phi_sampler: str = "conditional"
+
+    # Factor-reuse engine (ops/factor_cache.py): thread accepted
+    # Cholesky factors through the Gibbs sweep instead of
+    # re-factorizing. With the collapsed phi sampler, (a) the dense
+    # u-draw consumes the S-factor the collapsed block just selected
+    # (killing its own per-sweep O(m^3) factorization on update
+    # sweeps), and (b) the prior-factor refresh chol(R(phi')) and the
+    # solve-operator cache refresh run inside the ACCEPT branch of a
+    # lax.cond, so a rejected proposal pays only the two marginal-
+    # ratio factorizations (compute-then-select paid the full accept
+    # path on every rejection). Chains are bit-identical either way —
+    # the reused factors are the same matrices factored by the same
+    # kernel (ops/chol.py shifted_cholesky;
+    # tests/test_factor_reuse.py asserts bitwise equality) — so False
+    # exists only as a measurement baseline for the factorization-
+    # count protocol (FACTOR_REUSE_*.jsonl) and as an escape hatch.
+    factor_reuse: bool = True
 
     # Solver for the u-update's (R + D) system: "chol" = exact dense
     # Cholesky; "cg" = fixed-iteration conjugate gradient with R
@@ -357,6 +398,10 @@ class SMKConfig:
             raise ValueError(
                 "phi_sampler must be 'conditional' or 'collapsed'"
             )
+        if not isinstance(self.factor_reuse, bool):
+            raise ValueError(
+                f"factor_reuse must be a bool, got {self.factor_reuse!r}"
+            )
         if self.n_chains < 1:
             raise ValueError("n_chains must be >= 1")
         if not 0.0 < self.phi_target_accept < 1.0:
@@ -375,6 +420,34 @@ class SMKConfig:
         ):
             raise ValueError(
                 f"unknown matmul_precision {self.matmul_precision!r}"
+            )
+
+    def warn_if_tempered_multivariate(self, q: int) -> None:
+        """Warn when ``priors.temper='power'`` meets a multivariate
+        (q >= 2) fit — the config itself never sees q, so the entry
+        points that do (api.fit_meta_kriging, and through it the R
+        front-end) call this once the response count is known.
+
+        Evidence: SMK_QUALITY_r05.jsonl — all four q=2 cells fail the
+        tempered-prior quality gate (meta-vs-full K gaps of 2-4
+        full-posterior sd). With two responses the IW prior is
+        load-bearing for identifying the coregionalization scale, and
+        the 1/K-powered prior lets K drift high. Tempering is
+        validated at q=1 only (SMK_QUALITY_r04.jsonl: K[0,0] gap
+        1.9 -> 0.9 sd)."""
+        if self.priors.temper == "power" and q >= 2:
+            import warnings
+
+            warnings.warn(
+                "priors.temper='power' with q>=2 responses is known to "
+                "over-correct: the 1/K-tempered IW prior "
+                "under-identifies the coregionalization scale K "
+                "(meta-vs-full gaps of 2-4 posterior sd, "
+                "SMK_QUALITY_r05.jsonl). Tempering is validated for "
+                "q=1 only — prefer priors.temper='none' for "
+                "multivariate fits.",
+                UserWarning,
+                stacklevel=3,
             )
 
     def effective_jitter(self, m: int) -> float:

@@ -66,9 +66,18 @@ from smk_tpu.ops.chol import (
     blocked_tri_solve,
     chol_logdet,
     chol_solve,
+    finite_factor,
     jittered_cholesky,
     panel_inverses,
+    shifted_cholesky,
     tri_solve,
+)
+from smk_tpu.ops.factor_cache import (
+    FactorCache,
+    empty_counter,
+    scatter_component,
+    select_accept,
+    tick,
 )
 from smk_tpu.ops.cg import (
     cg_solve,
@@ -81,6 +90,26 @@ from smk_tpu.ops.kernels import correlation
 from smk_tpu.ops.polya_gamma import sample_pg
 from smk_tpu.ops.quantiles import quantile_grid
 from smk_tpu.ops.truncnorm import sample_albert_chib_latent
+
+# jax 0.4.x ships no batching rule for lax.optimization_barrier, so
+# any vmapped program containing the collapsed sampler's barrier-
+# sequenced memory discipline (collapsed_phi_block below) dies with
+# NotImplementedError — including every K-fan-out executor path. The
+# barrier is identity on values; its batching rule is simply "barrier
+# the batched values, pass the batch dims through". Registered
+# idempotently so newer jax versions that grow their own rule win.
+try:  # pragma: no cover - version-dependent
+    from jax.interpreters import batching as _batching
+
+    _ob_p = lax.optimization_barrier_p
+    if _ob_p not in _batching.primitive_batchers:
+
+        def _ob_batch_rule(args, dims):
+            return _ob_p.bind(*args), dims
+
+        _batching.primitive_batchers[_ob_p] = _ob_batch_rule
+except Exception:
+    pass
 
 
 class SubsetData(NamedTuple):
@@ -120,53 +149,14 @@ class SamplerState(NamedTuple):
     # batch adaptation, R:83)
 
 
-class SolveCache(NamedTuple):
-    """phi-dependent solve operators carried across Gibbs sweeps.
-
-    With ``phi_update_every = e``, phi changes at most every e-th sweep
-    — yet round 3's trace billed ~20 of 68.5 ms/iter at the north-star
-    slice to rebuilding bit-identical matrices every sweep (the masked
-    correlation, its bfloat16 cast for the CG matvec, and the Nystrom
-    factor). These are pure functions of phi, so they ride the scan
-    carry NEXT TO SamplerState — not inside it, keeping the checkpoint
-    format untouched — and are refreshed only inside the phi-MH branch
-    on acceptance (where the proposal's correlation is built anyway).
-    Chunk boundaries rebuild the cache from the carried state —
-    r_mv/nys_z from state.phi, chol_inv from state.chol_r — all
-    deterministic functions of checkpointed values, so chunking and
-    kill/resume stay bit-exact.
-
-    r_mv:  (q, m, m) masked correlation in the CG matvec dtype
-           (bfloat16 at bench scale — half the HBM stream); None when
-           u_solver != "cg".
-    nys_z: (q, m, rank) Nystrom factor Z (ops/cg.py nystrom_factor),
-           or None when cg_precond != "nystrom".
-    chol_inv: (q, nb, p, p) diagonal-panel inverses of the carried
-           chol_r for the blocked triangular solves (the phi-MH
-           log-likelihood and kriging conditionals — ops/chol.py
-           blocked_tri_solve); None when trisolve_block_size == 0 or
-           m is too small for the blocked solve to engage.
-    krige_w: (q, m, t) W = R~^{-1} R_cross — the kriging weights. The
-           composition-sampling draw (spPredict equivalent, R:85-87)
-           needs cond_mean = R_c^T R^{-1} u per kept iteration; W is a
-           pure function of phi, so carrying it turns the two m-sized
-           per-draw trisolves the r4 probe measured at ~15 ms/iter of
-           sampling-phase overhead into one (t, m) @ (m,) GEMV. Built
-           only for collecting scans (burn-in carries None) and
-           rebuilt on every phi-UPDATE sweep inside the MH branch
-           (acceptance only selects which value is kept), so the
-           t-rhs blocked-solve pair amortizes over phi_update_every
-           sweeps.
-    krige_chol: (q, t, t) Cholesky of the phi-only conditional
-           covariance R_test - W^T R_cross (+ jitter), cached for the
-           same reason.
-    """
-
-    r_mv: Optional[jnp.ndarray]
-    nys_z: Optional[jnp.ndarray]
-    chol_inv: Optional[jnp.ndarray]
-    krige_w: Optional[jnp.ndarray] = None
-    krige_chol: Optional[jnp.ndarray] = None
+# The carried factor cache (phi-dependent solve operators + the
+# factorization counter) now lives in ops/factor_cache.py — the
+# factor-reuse engine. It still rides the scan carry NEXT TO
+# SamplerState (never inside it, keeping the checkpoint format
+# untouched); chunk boundaries rebuild it deterministically from the
+# carried state (_solve_cache), so chunking and kill/resume stay
+# bit-exact. The historical name is kept as an alias.
+SolveCache = FactorCache
 
 
 class SubsetResult(NamedTuple):
@@ -312,7 +302,7 @@ class SpatialGPSampler:
         self, r_prop, chol_prop, inv_prop, phi_prop, mask,
         dist_cross, dist_test, cache,
     ):
-        """Proposal-side values for every populated SolveCache field —
+        """Proposal-side values for every populated FactorCache field —
         the ONE inventory both phi-MH refresh sites draw from (the
         batched conditional step and the per-component collapsed
         block), so adding a cache field forces both to handle it or
@@ -320,8 +310,11 @@ class SpatialGPSampler:
         (batched q, or 1 for a single component); None fields mirror
         the cache's population.
 
-        Returns a SolveCache of proposal values; the caller does the
-        accept-select (and, for the per-component site, the scatter).
+        Returns a FactorCache of proposal values (the counter carried
+        through unchanged — no m x m factorization happens here); the
+        caller does the accept-select (ops/factor_cache.select_accept)
+        or, for the per-component site, the scatter
+        (scatter_component).
         """
         cfg = self.config
         r_mv_p = nys_p = kw_p = kc_p = None
@@ -332,17 +325,21 @@ class SpatialGPSampler:
                 chol_prop, phi_prop, mask, dist_cross, dist_test,
                 inv_prop,
             )
-        return SolveCache(
+        return FactorCache(
             r_mv=r_mv_p, nys_z=nys_p, chol_inv=inv_prop,
-            krige_w=kw_p, krige_chol=kc_p,
+            krige_w=kw_p, krige_chol=kc_p, n_chol=cache.n_chol,
         )
 
     def _solve_cache(
         self, dist, mask, state, *, consts=None, predict: bool = False
-    ) -> Optional[SolveCache]:
+    ) -> FactorCache:
         """Cache for the current (phi, chol_r) — the scan-entry (and
         chunk-boundary) build; deterministic in the carried state, so
-        rebuilding here is bit-identical to the carried value.
+        rebuilding here is bit-identical to the carried value. Always
+        returns a FactorCache (fields may be None when the config
+        doesn't use them); the factorization counter starts at zero,
+        so a scan's final ``cache.n_chol`` is the count of m x m
+        factorizations that scan executed (count_chunk).
 
         ``predict=True`` (collecting scans only) additionally builds
         the kriging operators from ``consts``' cross/test distances —
@@ -365,11 +362,10 @@ class SpatialGPSampler:
                 state.chol_r, state.phi, mask, consts[1], consts[2],
                 chol_inv,
             )
-        if r_mv is None and chol_inv is None and krige_w is None:
-            return None
-        return SolveCache(
+        return FactorCache(
             r_mv=r_mv, nys_z=nys_z, chol_inv=chol_inv,
             krige_w=krige_w, krige_chol=krige_chol,
+            n_chol=empty_counter(),
         )
 
     # ------------------------------------------------------------------
@@ -526,7 +522,8 @@ class SpatialGPSampler:
                     cfg.cov_model,
                 )
                 chol_prop = self._chol_r(r_prop)
-            inv_cur = None if cache is None else cache.chol_inv
+            cache2 = tick(cache, q)  # the (q, m, m) proposal factor
+            inv_cur = cache.chol_inv
             inv_prop = (
                 self._chol_inv(chol_prop)
                 if self._use_blocked_tri(m)
@@ -542,39 +539,31 @@ class SpatialGPSampler:
                 jax.random.uniform(kphi, (q,), dtype, minval=1e-12)
             ) < log_ratio
             acc3 = accept[:, None, None]
-            if cache is None:
-                cache_new = None
-            else:
-                # the proposal's correlation/factor are in hand —
-                # refresh the carried solve operators for accepted
-                # components only (_proposal_operators is the single
-                # field inventory shared with the collapsed block's
-                # refresh and the chunk-boundary rebuild)
-                with jax.named_scope("cache_refresh"):
+
+            # the proposal's correlation/factor are in hand — refresh
+            # the carried solve operators for accepted components only
+            # (_proposal_operators is the single field inventory
+            # shared with the collapsed block's refresh and the
+            # chunk-boundary rebuild). Under factor_reuse the whole
+            # refresh sits in the accept arm of a lax.cond: a
+            # fully-rejected update sweep pays zero cache rebuilds
+            # (on an unbatched program the cond is a real branch; the
+            # legacy path computed the refresh and selected it away).
+            with jax.named_scope("cache_refresh"):
+
+                def refresh(c):
                     prop_ops = self._proposal_operators(
                         r_prop, chol_prop, inv_prop, phi_prop, mask,
-                        dist_cross, dist_test, cache,
+                        dist_cross, dist_test, c,
                     )
+                    return select_accept(prop_ops, c, accept)
 
-                    def sel(p, cur, extra_dims):
-                        if cur is None:
-                            return None
-                        acc_b = accept.reshape(
-                            accept.shape + (1,) * extra_dims
-                        )
-                        return jnp.where(acc_b, p, cur)
-
-                    cache_new = SolveCache(
-                        r_mv=sel(prop_ops.r_mv, cache.r_mv, 2),
-                        nys_z=sel(prop_ops.nys_z, cache.nys_z, 2),
-                        chol_inv=sel(
-                            prop_ops.chol_inv, cache.chol_inv, 3
-                        ),
-                        krige_w=sel(prop_ops.krige_w, cache.krige_w, 2),
-                        krige_chol=sel(
-                            prop_ops.krige_chol, cache.krige_chol, 2
-                        ),
+                if cfg.factor_reuse:
+                    cache_new = lax.cond(
+                        jnp.any(accept), refresh, lambda c: c, cache2
                     )
+                else:
+                    cache_new = refresh(cache2)
             return (
                 jnp.where(accept, phi_prop, phi),
                 jnp.where(acc3, chol_prop, chol_cur),
@@ -640,8 +629,27 @@ class SpatialGPSampler:
         # by [u_j | everything] is a valid partially-collapsed Gibbs
         # block, and sequencing components keeps q > 1 valid (each
         # phi_j conditions on the other components' CURRENT u).
+        # Whether the collapsed block threads its selected S-factor
+        # into the dense u-draw (the factor-reuse engine's headline
+        # saving: the draw's own per-sweep O(m^3) factorization
+        # disappears — VERDICT r5 weak #5 / next #5). Static: the cg
+        # path never factors S, and the legacy (factor_reuse=False)
+        # path keeps the refactorize-and-measure baseline.
+        thread_s = (
+            cfg.factor_reuse
+            and cfg.phi_sampler == "collapsed"
+            and cfg.u_solver == "chol"
+        )
+
         def collapsed_phi_block(j, phi, chol_r, cache, ytilde, d_vec):
-            def upd(_):
+            """One component's partially-collapsed phi move. Returns
+            (phi, chol_r, cache, accept, chol_s): chol_s is the
+            S-factor at the SELECTED phi (only when ``thread_s``,
+            else None) — handed to the u-draw so it never
+            re-factorizes."""
+            shift = jit_eff + d_vec
+
+            def upd(cache):
                 phi_j = phi[j]
                 step = jnp.exp(state.phi_log_step[j])
                 t_cur = jnp.log((phi_j - lo) / (hi - phi_j))
@@ -652,7 +660,6 @@ class SpatialGPSampler:
                 sig_cur = jax.nn.sigmoid(t_cur)
                 sig_prop = jax.nn.sigmoid(t_prop)
                 phi_prop = lo + (hi - lo) * sig_prop
-                shift = jit_eff + d_vec
 
                 def marg_ll(phi_v):
                     # the marginal's S = R~(phi) + jit I + D: pad rows
@@ -664,14 +671,12 @@ class SpatialGPSampler:
                         r = masked_correlation(
                             dist, phi_v, mask, cfg.cov_model
                         )
-                        chol_s = jittered_cholesky(
-                            r + jnp.diag(shift), 0.0
-                        )
+                        chol_s = shifted_cholesky(r, shift)
                     alpha = self._tri(chol_s, ytilde)
                     ll = -0.5 * jnp.sum(alpha * alpha) - 0.5 * (
                         chol_logdet(chol_s)
                     )
-                    return ll, r
+                    return ll, r, chol_s
 
                 # The three m^2 workspaces of a collapsed update
                 # (S_cur, S_prop, R_prop factor chains) must NOT be
@@ -680,21 +685,41 @@ class SpatialGPSampler:
                 # by ~300 MB at the config-5 slice (measured OOM).
                 # The barriers sequence cur -> prop -> refresh so each
                 # chain's temporaries die before the next allocates.
-                ll_cur, _ = marg_ll(phi_j)
-                ll_cur, phi_prop = lax.optimization_barrier(
-                    (ll_cur, phi_prop)
-                )
-                ll_prop, r_prop = marg_ll(phi_prop)
-                ll_prop, r_prop = lax.optimization_barrier(
-                    (ll_prop, r_prop)
-                )
+                # (thread_s retains the cur S-factor through the prop
+                # chain — one extra live m^2 buffer, taken only on
+                # the dense small-m path, never at cg/bench scale.)
+                cache = tick(cache, 2)  # S_cur and S_prop
+                ll_cur, _, chol_s_cur = marg_ll(phi_j)
+                if thread_s:
+                    ll_cur, chol_s_cur, phi_prop = (
+                        lax.optimization_barrier(
+                            (ll_cur, chol_s_cur, phi_prop)
+                        )
+                    )
+                else:
+                    chol_s_cur = None
+                    ll_cur, phi_prop = lax.optimization_barrier(
+                        (ll_cur, phi_prop)
+                    )
+                ll_prop, r_prop, chol_s_prop = marg_ll(phi_prop)
+                if thread_s:
+                    ll_prop, r_prop, chol_s_prop = (
+                        lax.optimization_barrier(
+                            (ll_prop, r_prop, chol_s_prop)
+                        )
+                    )
+                else:
+                    chol_s_prop = None
+                    ll_prop, r_prop = lax.optimization_barrier(
+                        (ll_prop, r_prop)
+                    )
                 log_ratio = (
                     ll_prop
                     + jnp.log(sig_prop * (1.0 - sig_prop))
                     - ll_cur
                     - jnp.log(sig_cur * (1.0 - sig_cur))
                 )
-                accept = (
+                accept_mh = (
                     jnp.log(
                         jax.random.uniform(
                             jax.random.fold_in(kphi, j), (), dtype,
@@ -703,30 +728,29 @@ class SpatialGPSampler:
                     )
                     < log_ratio
                 )
-                # the carried prior factor (u* draws, kriging) must
-                # track the accepted phi — the third m^3 factorization
-                # of a collapsed update (see SMKConfig.phi_sampler)
-                with jax.named_scope("phi_chol"):
-                    chol_prop = self._chol_r(r_prop)
-                # fp32 guard: the marginal ratio factors the WELL-
-                # conditioned S = R + jit I + D, so it can accept a
-                # phi whose bare R + jit I factorization fails on
-                # near-duplicate locations (measured: eBird Thomas-
-                # cluster subsets at m=1024 — a NaN factor entered
-                # the carry and killed the chain). The conditional
-                # sampler is implicitly protected because its ratio
-                # IS that factorization (NaN ratio -> reject); the
-                # collapsed accept must impose the same rejection.
-                accept = accept & jnp.all(
-                    jnp.isfinite(jnp.diagonal(chol_prop))
-                )
-                phi_new = jnp.where(accept, phi_prop, phi_j)
-                chol_j = jnp.where(accept, chol_prop, chol_r[j])
-                cache_new = cache
-                if cache is not None:
-                    # same field inventory as the conditional step's
-                    # refresh — _proposal_operators with a 1-length
-                    # component axis, then a per-slice accept-select
+
+                def accept_products(cache):
+                    # the carried prior factor (u* draws, kriging)
+                    # must track the accepted phi — the third m^3
+                    # factorization of a collapsed update (see
+                    # SMKConfig.phi_sampler) — plus the solve-operator
+                    # refresh (same field inventory as the conditional
+                    # step's, via _proposal_operators with a 1-length
+                    # component axis).
+                    with jax.named_scope("phi_chol"):
+                        chol_prop = self._chol_r(r_prop)
+                    cache = tick(cache, 1)
+                    # fp32 guard: the marginal ratio factors the WELL-
+                    # conditioned S = R + jit I + D, so it can accept
+                    # a phi whose bare R + jit I factorization fails
+                    # on near-duplicate locations (measured: eBird
+                    # Thomas-cluster subsets at m=1024 — a NaN factor
+                    # entered the carry and killed the chain). The
+                    # conditional sampler is implicitly protected
+                    # because its ratio IS that factorization (NaN
+                    # ratio -> reject); the collapsed accept must
+                    # impose the same rejection.
+                    ok = finite_factor(chol_prop)
                     with jax.named_scope("cache_refresh"):
                         inv_prop_j = (
                             panel_inverses(
@@ -743,72 +767,133 @@ class SpatialGPSampler:
                             phi_prop[None], mask, dist_cross,
                             dist_test, cache,
                         )
+                    return chol_prop, prop_ops, ok, cache
 
-                        def sel_j(p, cur):
-                            if cur is None:
-                                return None
-                            return cur.at[j].set(
-                                jnp.where(accept, p[0], cur[j])
-                            )
-
-                        cache_new = SolveCache(
-                            r_mv=sel_j(prop_ops.r_mv, cache.r_mv),
-                            nys_z=sel_j(prop_ops.nys_z, cache.nys_z),
-                            chol_inv=sel_j(
-                                prop_ops.chol_inv, cache.chol_inv
-                            ),
-                            krige_w=sel_j(
-                                prop_ops.krige_w, cache.krige_w
-                            ),
-                            krige_chol=sel_j(
-                                prop_ops.krige_chol, cache.krige_chol
-                            ),
+                def sel_out(acc, chol_prop, cache):
+                    out = (
+                        jnp.where(acc, phi_prop, phi_j),
+                        jnp.where(acc, chol_prop, chol_r[j]),
+                        cache,
+                        acc.astype(dtype),
+                    )
+                    if thread_s:
+                        out += (
+                            jnp.where(acc, chol_s_prop, chol_s_cur),
                         )
+                    return out
+
+                if cfg.factor_reuse:
+                    # accept-gated: a rejected proposal never builds
+                    # the prior factor or touches the cache — zero
+                    # m^3 work beyond the two marginal factorizations
+                    # (a real branch on unbatched programs; a select
+                    # under a vmapped K axis, where n_chol still
+                    # records the logical count)
+                    def on_accept(cache):
+                        chol_prop, prop_ops, ok, cache = (
+                            accept_products(cache)
+                        )
+                        cache = scatter_component(
+                            prop_ops, cache, j, ok
+                        )
+                        return sel_out(ok, chol_prop, cache)
+
+                    def on_reject(cache):
+                        out = (
+                            phi_j,
+                            chol_r[j],
+                            cache,
+                            jnp.zeros((), dtype),
+                        )
+                        if thread_s:
+                            out += (chol_s_cur,)
+                        return out
+
+                    res = lax.cond(
+                        accept_mh, on_accept, on_reject, cache
+                    )
+                else:
+                    # legacy compute-then-select baseline: the accept
+                    # side is built unconditionally and a rejection
+                    # merely selects it away
+                    chol_prop, prop_ops, ok, cache = accept_products(
+                        cache
+                    )
+                    acc = accept_mh & ok
+                    cache = scatter_component(prop_ops, cache, j, acc)
+                    res = sel_out(acc, chol_prop, cache)
+
+                phi_new, chol_j, cache, acc_f = res[:4]
+                chol_s_sel = res[4] if thread_s else None
                 return (
                     phi.at[j].set(phi_new),
                     chol_r.at[j].set(chol_j),
-                    cache_new,
-                    accept.astype(dtype),
+                    cache,
+                    acc_f,
+                    chol_s_sel,
                 )
 
-            def keep(_):
-                return phi, chol_r, cache, jnp.zeros((), dtype)
+            def keep(cache):
+                chol_s = None
+                if thread_s:
+                    # non-update sweep: the u-draw still needs the
+                    # S-factor at the current phi — built here (inside
+                    # the schedule cond) so the draw itself never
+                    # factorizes; same per-sweep count as the legacy
+                    # dense path, one site instead of two
+                    r0 = masked_correlation(
+                        dist, phi[j], mask, cfg.cov_model
+                    )
+                    chol_s = shifted_cholesky(r0, shift)
+                    cache = tick(cache, 1)
+                return phi, chol_r, cache, jnp.zeros((), dtype), chol_s
 
             if cfg.phi_update_every == 1:
-                return upd(None)
+                return upd(cache)
             return lax.cond(
-                it % cfg.phi_update_every == 0, upd, keep, None
+                it % cfg.phi_update_every == 0, upd, keep, cache
             )
 
         e0 = zbar - eta_fixed  # (m, q)
         big = jnp.asarray(cfg.mask_noise_var, dtype)
         ku_priors = jax.random.split(ku_prior, q)
         ku_noises = jax.random.split(ku_noise, q)
-        for j in range(q):
+
+        # Components update SEQUENTIALLY (each phi_j / u_j conditions
+        # on the other components' CURRENT u), so the loop is a
+        # lax.scan over j — one compiled body whatever q is. The
+        # Python-unrolled form inlined q copies of the collapsed
+        # block's three m^3 chains + krige rebuild, growing compile
+        # time and peak HBM linearly with q (the documented v5e OOM
+        # headroom problem; ADVICE r5).
+        def component_update(carry, xs):
+            phi, chol_r, cache, u, accepted = carry
+            j, ku_p, ku_n = xs
             a_j = a[:, j]  # (q,)
             # residual excluding component j's contribution
             w_full = u @ a.T
-            partial = e0 - w_full + jnp.outer(u[:, j], a_j)
+            partial_resid = e0 - w_full + jnp.outer(u[:, j], a_j)
             c_vec = womega @ (a_j * a_j)  # (m,)
-            b_vec = (womega * partial) @ a_j  # (m,)
+            b_vec = (womega * partial_resid) @ a_j  # (m,)
             c_safe = jnp.maximum(c_vec, 1.0 / big)
             ytilde = b_vec / c_safe
             d_vec = jnp.minimum(1.0 / c_safe, big)  # noise variance
+            chol_s = None
             if cfg.phi_sampler == "collapsed":
-                phi, chol_r, cache, acc_j = collapsed_phi_block(
+                phi, chol_r, cache, acc_j, chol_s = collapsed_phi_block(
                     j, phi, chol_r, cache, ytilde, d_vec
                 )
                 accepted = accepted.at[j].set(acc_j)
             l_j = chol_r[j]
             # prior draw u* = L xi  and noise draw eta* = sqrt(d) xi2
-            u_star = l_j @ jax.random.normal(ku_priors[j], (m,), dtype)
+            u_star = l_j @ jax.random.normal(ku_p, (m,), dtype)
             eta_star = jnp.sqrt(d_vec) * jax.random.normal(
-                ku_noises[j], (m,), dtype
+                ku_n, (m,), dtype
             )
             rhs_vec = ytilde - u_star - eta_star
             if cfg.u_solver == "cg":
                 # (R + D) x = rhs with R applied *directly* from the
-                # CARRIED matvec matrix (SolveCache.r_mv — already in
+                # CARRIED matvec matrix (FactorCache.r_mv — already in
                 # the matvec dtype), so each CG step is ONE m x m
                 # matvec instead of the two through the carried factor
                 # and no per-sweep rebuild/cast touches HBM. The solve
@@ -847,21 +932,29 @@ class SpatialGPSampler:
                     )
             else:
                 # exact dense path: R rebuilt elementwise from the
-                # distance matrix — O(m^2), not the O(m^3) L @ L^T.
-                # The jitter enters once, here (it is part of the
-                # prior covariance the carried chol_r factors).
-                # Known redundancy under phi_sampler="collapsed": on
-                # update sweeps this refactorizes the S the collapsed
-                # block just factored (threading the selected factor
-                # through the cond is not worth the plumbing — the
-                # dense path is the small-m option, u_solver="cg" is
-                # the scaling path).
-                r_mat = masked_correlation(
+                # distance matrix — O(m^2), not the O(m^3) L @ L^T;
+                # the jitter rides the diagonal shift and the Matheron
+                # back-multiply, so the factored S is bit-identical
+                # to the collapsed block's (shifted_cholesky). With
+                # thread_s the factor arrives from that block and the
+                # draw performs NO factorization of its own; the
+                # conditional sampler and the factor_reuse=False
+                # baseline still factor here.
+                r0 = masked_correlation(
                     dist, phi[j], mask, cfg.cov_model
-                ) + jit_eff * jnp.eye(m, dtype=dtype)
-                chol_m = jittered_cholesky(r_mat + jnp.diag(d_vec), 0.0)
-                s = chol_solve(chol_m, rhs_vec)
-                u = u.at[:, j].set(u_star + r_mat @ s)
+                )
+                if chol_s is None:
+                    chol_s = shifted_cholesky(r0, jit_eff + d_vec)
+                    cache = tick(cache, 1)
+                s = chol_solve(chol_s, rhs_vec)
+                u = u.at[:, j].set(u_star + r0 @ s + jit_eff * s)
+            return (phi, chol_r, cache, u, accepted), None
+
+        (phi, chol_r, cache, u, accepted), _ = lax.scan(
+            component_update,
+            (phi, chol_r, cache, u, accepted),
+            (jnp.arange(q), ku_priors, ku_noises),
+        )
 
         if cfg.phi_sampler == "collapsed":
             phi_accept = state.phi_accept + accepted
@@ -953,9 +1046,9 @@ class SpatialGPSampler:
         # prior-only noise and must not leak into the test sites.
         t_test = data.coords_test.shape[0]
         kpred_q = jax.random.split(kpred, q)
-        if cache is not None and cache.krige_w is not None:
+        if cache.krige_w is not None:
             # cached-operator path: W = R^{-1} R_c and chol(cond_cov)
-            # are phi-only and carried in the SolveCache (refreshed on
+            # are phi-only and carried in the FactorCache (refreshed on
             # phi acceptance), so each kept draw is one (t, m) GEMV +
             # one (t, t) matvec — the two per-draw m-sized trisolves
             # the r4 probe billed ~15 ms/iter of sampling overhead to
@@ -998,7 +1091,7 @@ class SpatialGPSampler:
                 z = jax.random.normal(key_j, (t_test,), dtype)
                 return cond_mean + chol_c @ z
 
-            if cache is not None and cache.chol_inv is not None:
+            if cache.chol_inv is not None:
                 u_star_test = jax.vmap(krige)(
                     chol_r, r_cross, r_test, u.T, kpred_q,
                     cache.chol_inv,
@@ -1126,6 +1219,44 @@ class SpatialGPSampler:
                 step, (state, cache), start_it + jnp.arange(n_iters)
             )
             return state
+
+    def count_chunk(
+        self,
+        data: SubsetData,
+        state: SamplerState,
+        start_it,
+        n_iters: int,
+        *,
+        collect: bool = False,
+    ):
+        """Instrumented non-collecting scan: advance ``n_iters`` Gibbs
+        sweeps from ``state`` and return ``(state, n_chol)`` where
+        ``n_chol`` is the number of m x m Cholesky factorizations the
+        scan performed (the FactorCache.n_chol carry — counted inside
+        whichever cond branch executes, so accept and reject sweeps
+        report their true cost). This is the measurement behind the
+        factor-reuse protocol (scripts/factor_reuse_probe.py,
+        bench.py's factor_reuse record, tests/test_factor_reuse.py);
+        the state advances exactly as burn_chunk's would
+        (``collect=False``) or sample_chunk's (``collect=True``,
+        draws discarded), so counts attach to a real chain.
+        """
+        cfg = self.config
+        with jax.default_matmul_precision(cfg.matmul_precision):
+            consts = self._consts(data)
+            cache = self._solve_cache(
+                consts[0], data.mask, state, consts=consts,
+                predict=collect,
+            )
+            step = lambda carry, it: (
+                self._gibbs_step(data, consts, carry, it,
+                                 collect=collect)[0],
+                None,
+            )
+            (state, cache), _ = lax.scan(
+                step, (state, cache), start_it + jnp.arange(n_iters)
+            )
+            return state, cache.n_chol
 
     def sample_chunk(
         self,

@@ -104,6 +104,32 @@ def blocked_cholesky(
     return l_full[..., :m, :m]
 
 
+def shifted_cholesky(r: jnp.ndarray, shift: jnp.ndarray) -> jnp.ndarray:
+    """Lower Cholesky factor of ``r + diag(shift)`` — the S-matrix
+    build of the collapsed-phi marginal AND the dense u-draw
+    (models/probit_gp.py), factored here so both sites construct
+    bit-identical inputs: S = R~(phi) + diag(jitter + d). The
+    factor-reuse engine (ops/factor_cache.py) hands the collapsed
+    block's selected S-factor to the u-draw, which is only sound
+    because the u-draw's own fallback build goes through this exact
+    function (same addition order, same factorization kernel).
+
+    r: (..., m, m); shift: scalar or (..., m) positive diagonal.
+    """
+    shift = jnp.zeros(r.shape[:-1], r.dtype) + shift
+    eye = jnp.eye(r.shape[-1], dtype=r.dtype)
+    return jnp.tril(lax.linalg.cholesky(r + shift[..., None] * eye))
+
+
+def finite_factor(chol_l: jnp.ndarray) -> jnp.ndarray:
+    """Scalar bool per batch element: every diagonal entry of the
+    factor finite — the fp32 accept guard of the collapsed sampler
+    (a NaN factor must never enter the carry; see
+    models/probit_gp.py)."""
+    diag = jnp.diagonal(chol_l, axis1=-2, axis2=-1)
+    return jnp.all(jnp.isfinite(diag), axis=-1)
+
+
 def tri_solve(chol_l: jnp.ndarray, b: jnp.ndarray, *, trans: bool = False) -> jnp.ndarray:
     """Solve L x = b (or L^T x = b when trans) for lower-triangular L."""
     return solve_triangular(chol_l, b, lower=True, trans=1 if trans else 0)

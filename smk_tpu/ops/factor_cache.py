@@ -1,0 +1,160 @@
+"""Factor-reuse engine: the cache of accepted Cholesky-derived
+operators threaded through the Gibbs hot loop.
+
+The sampler's dominant cost is the per-iteration O(m^3) factorization
+chain (SURVEY.md §2.3): the collapsed-phi block factors
+S = R(phi) + jit I + D at the current and proposed phi, an accepted
+move additionally refactors R(phi') for the carried prior factor, and
+— before this module — the dense u-draw refactored the very S the
+collapsed block had just factored, and every rejected proposal still
+paid the full accept-side rebuild (compute-then-select). Because the
+SMK fan-out is share-nothing, every factorization saved here
+multiplies across all K subsets and all chains.
+
+:class:`FactorCache` owns every operator that is a pure function of
+the accepted (phi, chol_r) — the CG matvec matrix, the Nystrom
+factor, the blocked-trisolve panel inverses, and the kriging
+operators — plus ``n_chol``, a carried counter of m x m
+factorizations actually performed (see below). It rides the scan
+carry NEXT TO ``SamplerState`` — never inside it, so the checkpoint
+format is untouched: chunk boundaries rebuild the cache
+deterministically from the carried state
+(``SpatialGPSampler._solve_cache``) and kill/resume stays bit-exact.
+
+Reuse contract (``SMKConfig.factor_reuse``, default on):
+
+- **accept** (collapsed phi): the freshly factored S at the accepted
+  phi is handed straight to the same component's u-draw (the dense
+  path's own Cholesky disappears), and the prior-factor refresh
+  chol(R(phi')) plus the cache refresh run inside the accept branch
+  of a ``lax.cond``.
+- **reject**: the cached operators carry forward untouched — a
+  rejected sweep pays the two proposal-evaluation factorizations and
+  nothing else (no R(phi') rebuild, no cache refresh). On an
+  unbatched program (one subset per device, the CPU default and the
+  per-subset shard) the cond is a real branch; under a vmapped K
+  axis XLA lowers it to a select, where ``n_chol`` still reports the
+  logical count a branching backend executes.
+
+``n_chol`` counts m x m factorizations only — the O(m^3) kernels the
+engine exists to eliminate. The O(p^3)/O(t^3) factorizations of the
+beta/A/krige-conditional updates are noise at scale and are not
+counted.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+
+
+class FactorCache(NamedTuple):
+    """phi-dependent solve operators carried across Gibbs sweeps.
+
+    With ``phi_update_every = e``, phi changes at most every e-th
+    sweep — yet round 3's trace billed ~20 of 68.5 ms/iter at the
+    north-star slice to rebuilding bit-identical matrices every sweep.
+    All fields except ``n_chol`` are pure functions of the accepted
+    (phi, chol_r) and are refreshed only inside the phi-MH accept
+    path (where the proposal's correlation is built anyway).
+
+    r_mv:  (q, m, m) masked correlation in the CG matvec dtype
+           (bfloat16 at bench scale — half the HBM stream); None when
+           u_solver != "cg".
+    nys_z: (q, m, rank) Nystrom factor Z (ops/cg.py nystrom_factor),
+           or None when cg_precond != "nystrom".
+    chol_inv: (q, nb, p, p) diagonal-panel inverses of the carried
+           chol_r for the blocked triangular solves (ops/chol.py
+           blocked_tri_solve); None when trisolve_block_size == 0 or
+           m is too small for the blocked solve to engage.
+    krige_w: (q, m, t) W = R~^{-1} R_cross — the kriging weights for
+           the composition-sampling draw (spPredict equivalent,
+           R:85-87). Built for collecting scans only (burn-in carries
+           None) and rebuilt on phi-UPDATE sweeps inside the MH
+           branch, so the t-rhs blocked-solve pair amortizes over
+           phi_update_every sweeps.
+    krige_chol: (q, t, t) Cholesky of the phi-only conditional
+           covariance R_test - W^T R_cross (+ jitter), cached for the
+           same reason.
+    n_chol: () int32 — running count of m x m Cholesky factorizations
+           performed since the cache was built (scan entry). Pure
+           instrumentation: it never feeds the chain, and it is
+           incremented inside whichever cond branch executes, so it
+           reports the logical factorization count per sweep (the
+           protocol number bench.py and the factor-reuse tests
+           assert on).
+    """
+
+    r_mv: Optional[jnp.ndarray]
+    nys_z: Optional[jnp.ndarray]
+    chol_inv: Optional[jnp.ndarray]
+    krige_w: Optional[jnp.ndarray] = None
+    krige_chol: Optional[jnp.ndarray] = None
+    n_chol: jnp.ndarray = None  # type: ignore[assignment]
+
+
+def empty_counter() -> jnp.ndarray:
+    """Fresh factorization counter (scan-entry value)."""
+    return jnp.zeros((), jnp.int32)
+
+
+def tick(cache: FactorCache, n: int) -> FactorCache:
+    """Record ``n`` m x m factorizations on the carried counter.
+
+    ``n`` is a static Python int (the count is structural per site:
+    q for a batched (q, m, m) factorization, 1 per component-level
+    one); call sites inside a lax.cond branch are counted only when
+    that branch runs, which is exactly the semantics the protocol
+    measurement needs.
+    """
+    return cache._replace(n_chol=cache.n_chol + jnp.int32(n))
+
+
+def select_accept(
+    prop: FactorCache, cur: FactorCache, accept: jnp.ndarray
+) -> FactorCache:
+    """Per-component accept-select between a proposal-side cache and
+    the current one. ``accept``: (q,) bool/0-1 mask aligned with the
+    leading component axis of every populated field; None fields stay
+    None (the two caches must be populated identically). The counter
+    is taken from ``prop`` (ticks recorded while building the
+    proposal side are real work regardless of acceptance)."""
+
+    def sel(p, c, extra_dims):
+        if c is None:
+            return None
+        acc_b = accept.reshape(accept.shape + (1,) * extra_dims)
+        return jnp.where(acc_b, p, c)
+
+    return FactorCache(
+        r_mv=sel(prop.r_mv, cur.r_mv, 2),
+        nys_z=sel(prop.nys_z, cur.nys_z, 2),
+        chol_inv=sel(prop.chol_inv, cur.chol_inv, 3),
+        krige_w=sel(prop.krige_w, cur.krige_w, 2),
+        krige_chol=sel(prop.krige_chol, cur.krige_chol, 2),
+        n_chol=prop.n_chol,
+    )
+
+
+def scatter_component(
+    prop: FactorCache, cur: FactorCache, j, accept: jnp.ndarray
+) -> FactorCache:
+    """Write component ``j``'s slice of a 1-component proposal cache
+    (leading axis length 1) into the full cache where ``accept`` (a
+    scalar bool) holds — the collapsed sampler's per-component refresh
+    site. The counter is taken from ``prop`` (see select_accept)."""
+
+    def sel_j(p, c):
+        if c is None:
+            return None
+        return c.at[j].set(jnp.where(accept, p[0], c[j]))
+
+    return FactorCache(
+        r_mv=sel_j(prop.r_mv, cur.r_mv),
+        nys_z=sel_j(prop.nys_z, cur.nys_z),
+        chol_inv=sel_j(prop.chol_inv, cur.chol_inv),
+        krige_w=sel_j(prop.krige_w, cur.krige_w),
+        krige_chol=sel_j(prop.krige_chol, cur.krige_chol),
+        n_chol=prop.n_chol,
+    )

@@ -152,6 +152,50 @@ def fit_subsets_vmap(
     )
 
 
+def count_subset_factorizations(
+    model: SpatialGPSampler,
+    part: Partition,
+    coords_test: jnp.ndarray,
+    x_test: jnp.ndarray,
+    key: jax.Array,
+    beta_init: Optional[jnp.ndarray] = None,
+    *,
+    n_iters: int,
+    start_it: int = 0,
+    collect: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Instrumented fan-out: advance every subset ``n_iters`` Gibbs
+    sweeps and return ``(phi_accepts, n_chol)`` — per-subset (K, q)
+    phi-acceptance counts and the per-subset (K,) count of m x m
+    Cholesky factorizations executed (FactorCache.n_chol).
+
+    This is the measurement entry point of the factor-reuse protocol
+    (scripts/factor_reuse_probe.py, bench.py's factor_reuse record):
+    the same vmapped program the executors run, with the carried
+    counter surfaced instead of discarded. Single-chain only — the
+    protocol compares per-sweep counts, which chains would just
+    multiply.
+    """
+    if model.config.n_chains != 1:
+        raise ValueError(
+            "count_subset_factorizations measures single-chain "
+            "programs; chains scale counts linearly"
+        )
+    data = _stacked_data(part, coords_test, x_test)
+    keys = subset_chain_keys(key, part.n_subsets, 1)
+    init = init_subset_states(model, keys, data, beta_init)
+    counted = jax.jit(
+        jax.vmap(
+            lambda d, s: model.count_chunk(
+                d, s, start_it, n_iters, collect=collect
+            ),
+            in_axes=(_DATA_AXES, 0),
+        )
+    )
+    state, n_chol = counted(data, init)
+    return state.phi_accept, n_chol
+
+
 def make_mesh(n_devices: Optional[int] = None, axis: str = "subsets") -> Mesh:
     """1-D device mesh over the subset axis (ICI on a real slice)."""
     devs = jax.devices()

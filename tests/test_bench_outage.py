@@ -1,0 +1,72 @@
+"""bench.py outage protocol (VERDICT r5 #1 / ISSUE 1 satellite).
+
+Round 5's driver record was EMPTY because ``bench.py:890`` touched
+``jax.devices()`` before the Reporter or signal handlers existed — a
+dead TPU tunnel crashed the process with zero JSON. The contract now:
+with the TPU backend unavailable, ``python bench.py`` still prints a
+valid aggregate JSON whose last stdout line carries
+``{"partial": true, "error": "tpu backend unavailable"}`` plus a
+measured CPU fallback rung — exercised here by pointing JAX_PLATFORMS
+at a nonexistent backend in a fresh subprocess.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_emits_partial_aggregate_when_backend_unavailable(
+    tmp_path,
+):
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS") and not k.startswith("BENCH_")
+    }
+    env.update({
+        # a backend name that cannot initialize — the probe's
+        # subprocess fails fast instead of hanging, which also covers
+        # the dead-tunnel raise (the hang path is covered by the
+        # probe's subprocess timeout by construction)
+        "JAX_PLATFORMS": "no_such_backend",
+        "BENCH_PROBE_ATTEMPTS": "1",
+        "BENCH_PROBE_WAIT_S": "60",
+        # keep the CPU fallback mini-rung tiny
+        "BENCH_SAMPLES": "24",
+        "BENCH_N": "256",
+        "BENCH_K": "2",
+        "BENCH_BUDGET_S": "240",
+        "BENCH_FACTOR_PROBE": "0",
+        "BENCH_CACHE_DIR": str(tmp_path / "jaxcache"),
+    })
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=540,
+    )
+    assert out.returncode == 0, out.stderr[-3000:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert lines, "bench printed nothing"
+    # EVERY emitted line is a valid aggregate (the streaming
+    # protocol), and the last one carries the outage marker
+    records = [json.loads(l) for l in lines]
+    last = records[-1]
+    assert last["partial"] is True
+    assert last["error"] == "tpu backend unavailable"
+    # the fallback rung measured something — the record is never empty
+    mini = [
+        r for r in last["ladder"]
+        if r.get("rung") == "config2_cpu_mini" and "fit_s" in r
+    ]
+    assert mini, last["ladder"]
+    assert mini[0]["fit_s"] > 0
+    # the first emitted aggregate already carried the error marker
+    # (emitted BEFORE the fallback rung ran — a crash there could not
+    # have blanked the record)
+    assert records[0]["partial"] is True
+    assert records[0].get("error") == "tpu backend unavailable"

@@ -1,0 +1,37 @@
+"""Config-level guardrails added in ISSUE 1: the q>=2 tempering
+warning (SMK_QUALITY_r05.jsonl evidence) and the factor_reuse toggle's
+validation. Pure-config tests — no sampler compile, so they cost
+nothing in the tier-1 window."""
+
+import warnings
+
+import pytest
+
+from smk_tpu.config import PriorConfig, SMKConfig
+
+
+def test_tempered_multivariate_warns():
+    cfg = SMKConfig(priors=PriorConfig(temper="power"))
+    with pytest.warns(UserWarning, match="SMK_QUALITY_r05"):
+        cfg.warn_if_tempered_multivariate(2)
+
+
+def test_tempered_univariate_silent():
+    cfg = SMKConfig(priors=PriorConfig(temper="power"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        cfg.warn_if_tempered_multivariate(1)
+
+
+def test_untempered_multivariate_silent():
+    cfg = SMKConfig()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        cfg.warn_if_tempered_multivariate(4)
+
+
+def test_factor_reuse_must_be_bool():
+    with pytest.raises(ValueError, match="factor_reuse"):
+        SMKConfig(factor_reuse=1)
+    assert SMKConfig(factor_reuse=False).factor_reuse is False
+    assert SMKConfig().factor_reuse is True

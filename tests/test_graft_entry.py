@@ -13,6 +13,7 @@ import subprocess
 import sys
 
 import jax
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
@@ -66,4 +67,36 @@ def test_dryrun_multichip_reexec_path():
         timeout=600,
     )
     assert out.returncode == 0, out.stderr[-2000:]
+    assert "dryrun_multichip ok" in out.stdout
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_never_inits_dead_backend():
+    """MULTICHIP_r05 regression (rc=124): with JAX_PLATFORMS naming a
+    non-CPU backend, the PARENT process used to initialize that
+    backend just to count devices — which blocks indefinitely on a
+    dead TPU tunnel. The parent must now skip the probe entirely and
+    go straight to the forced-CPU re-exec child. A nonexistent
+    backend name makes the old behavior fail fast (unknown backend
+    raises at init), so this passes iff the parent never touches its
+    own backend."""
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "_SMK_DRYRUN_CHILD")
+    }
+    env["JAX_PLATFORMS"] = "no_such_backend"
+    code = (
+        "import sys; sys.path.insert(0, sys.argv[1]); "
+        "from __graft_entry__ import dryrun_multichip; "
+        "dryrun_multichip(2)"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code, REPO],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=540,
+    )
+    assert out.returncode == 0, (out.stdout[-1000:], out.stderr[-3000:])
     assert "dryrun_multichip ok" in out.stdout
