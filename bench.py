@@ -106,8 +106,18 @@ def make_binary_field(key, n, q=1, p=2, phi=6.0, n_features=256):
     n=125k over the remote-tunnel backend (bench setup budget)."""
     kc, kw, kb, kcoef, kx, ky = jax.random.split(key, 6)
     coords = jax.random.uniform(kc, (n, 2), jnp.float32)
-    # exponential covariance = Matern-1/2; its spectral density is a
-    # Cauchy — sample frequencies as phi * standard Cauchy
+    # DELIBERATE misspecification, kept for ladder continuity
+    # (ADVICE r5): per-axis independent Cauchy frequencies sample the
+    # separable-product spectral measure, whose kernel is the
+    # L1-exponential exp(-phi(|h1|+|h2|)) — NOT the isotropic
+    # exp(-phi*||h||_2) the sampler fits (that one's 2-D spectral
+    # measure is the spherically-contoured bivariate Cauchy: a shared
+    # denominator across the two axes, as scripts/smk_quality.py now
+    # samples). For these rungs the field is only a realistic-looking
+    # binary surface driving a THROUGHPUT measurement, and changing
+    # the draw would silently re-seed every rung's data across
+    # rounds; the quality study, where ground-truth covariance
+    # matters, uses the corrected generator.
     freqs = phi * jax.random.cauchy(kw, (n_features, 2), jnp.float32)
     phase = jax.random.uniform(kb, (n_features,), jnp.float32, 0, 2 * np.pi)
     coef = jax.random.normal(kcoef, (q, n_features), jnp.float32)
@@ -163,8 +173,16 @@ def op_model(cfg, m, k, q, n_iters, n_kept, t):
     # phi MH: proposal Cholesky m^3/3 + rebuild + two triangular
     # solves; the collapsed sampler factors three matrices per update
     # (S at current and proposed phi + R(phi') for the carried prior
-    # factor — see SMKConfig.phi_sampler)
-    n_chol = 3 if getattr(cfg, "phi_sampler", "conditional") == "collapsed" else 1
+    # factor — see SMKConfig.phi_sampler). The multi-try engine
+    # (phi_proposals = J >= 2) factors 2J + 1 per update — the
+    # forward (J+1) + reference (J-1) batched stacks + R(phi') —
+    # issued as batched calls, but the FLOP count is per logical
+    # factorization either way.
+    j_try = getattr(cfg, "phi_proposals", 1)
+    if getattr(cfg, "phi_sampler", "conditional") == "collapsed":
+        n_chol = 3 if j_try == 1 else 2 * j_try + 1
+    else:
+        n_chol = 1
     chol_flops = per_comp * n_phi * (n_chol * m**3 / 3 + 4 * m * m)
     # kriging (collect iters). krige_cache=True (the default): the
     # W = R^-1 Rc pair + cond-cov factor are built only on phi-update
@@ -334,6 +352,13 @@ def rung_config(env, *, k, n_samples, cov_model, link, n_chains=1,
         # sparser schedule cuts the phi-cond share of the scan
         phi_update_every=int(env.get("BENCH_PHI_EVERY", phi_every)),
         phi_sampler=env.get("BENCH_PHI_SAMPLER", "collapsed"),
+        # multi-try phi (ISSUE 2): J batched proposals per collapsed
+        # update + the proposal family (gaussian/student_t/mixture).
+        # Default 1/gaussian = the r5 production chain bit-exactly;
+        # raise BENCH_PHI_PROPOSALS to measure the MTM engine on any
+        # rung (the mixing lever for config3's R-hat 1.453).
+        phi_proposals=int(env.get("BENCH_PHI_PROPOSALS", 1)),
+        phi_proposal_family=env.get("BENCH_PHI_FAMILY", "gaussian"),
         chol_block_size=int(env.get("BENCH_CHOL_BLOCK", 0)),
         # blocked-GEMM trisolves with carried panel inverses: XLA's
         # native trisolve is latency-bound at these shapes (measured
@@ -962,11 +987,14 @@ def measure_factor_reuse(*, n=512, k=4, q=1, n_iters=24,
     for reuse in (False, True):
         cfg = dataclasses.replace(base, factor_reuse=reuse)
         model = SpatialGPSampler(cfg, weight=1)
-        accepts, n_chol = count_subset_factorizations(
+        accepts, (n_chol, n_calls) = count_subset_factorizations(
             model, part, coords[:4], x[:4], jax.random.key(2),
-            n_iters=n_iters,
+            n_iters=n_iters, with_calls=True,
         )
-        out[reuse] = (np.asarray(accepts), np.asarray(n_chol))
+        out[reuse] = (
+            np.asarray(accepts), np.asarray(n_chol),
+            np.asarray(n_calls),
+        )
     acc = out[True][0].sum(axis=-1)  # (K,) accepted updates
     accepts_match = bool(np.array_equal(out[True][0], out[False][0]))
     # closed-form per-subset totals implied by the per-sweep protocol
@@ -989,6 +1017,14 @@ def measure_factor_reuse(*, n=512, k=4, q=1, n_iters=24,
             "before": [int(v) for v in out[False][1]],
             "after": [int(v) for v in out[True][1]],
         },
+        # batched Cholesky CALLS (multi-try accounting; at the J=1
+        # default every logical factorization is its own call except
+        # the conditional sampler's (q, m, m) batch, so this simply
+        # documents the baseline the MTM probe improves on)
+        "n_chol_calls_per_subset": {
+            "before": [int(v) for v in out[False][2]],
+            "after": [int(v) for v in out[True][2]],
+        },
         "per_sweep_protocol": {
             "accepted_update_sweep": {"before": 3 + u_draw, "after": 3},
             "rejected_update_sweep": {"before": 3 + u_draw, "after": 2},
@@ -1005,6 +1041,170 @@ def measure_factor_reuse(*, n=512, k=4, q=1, n_iters=24,
         ),
     }
     return record
+
+
+def measure_mtm(*, n=512, k=4, q=1, n_iters=24, phi_update_every=2,
+                j_tries=(1, 4, 8), family="student_t",
+                u_solver="cg", seed=7):
+    """Multi-try phi protocol (ISSUE 2): batched-call vs logical
+    factorization counts and the ISOLATED per-update wall-clock for a
+    J sweep on the collapsed sampler.
+
+    For each J the cell records:
+
+    - ``n_chol`` / ``n_chol_calls`` per subset (the carried
+      FactorCache pair): at J >= 2 each update issues TWO batched
+      Cholesky calls (the forward (J+1, m, m) candidate stack + the
+      (J-1, m, m) reference stack) for 2J logical factorizations —
+      vs one call per factorization on the sequential J=1 chains —
+      plus one call per accepted move for the R(phi') prior-factor
+      refresh. Counts are verified against the closed form
+      (``counts_match_protocol``).
+    - phi-update wall-clock isolated by DIFFERENCING: the counted
+      chunk is re-run with a schedule that triggers zero phi updates
+      (start_it=1, phi_update_every > n_iters), and the difference
+      attributes wall time to the update work alone. Exact on the cg
+      path, where non-update sweeps perform no m x m factorization.
+    - ``per_call_gflops``: achieved GFLOP/s of the proposal-side
+      factorization work, (logical x m^3/3) / isolated wall — the
+      attribution number for any eff_tflops movement (the batched
+      (J+1, m, m) shape is exactly what XLA maps onto the MXU;
+      utils/tracing.MTM_CHOL_SCOPE names it in profiles).
+
+    Counts are logical under a vmapped K axis exactly as in
+    measure_factor_reuse; the wall-clock is physical either way.
+    """
+    import dataclasses
+
+    from smk_tpu.config import SMKConfig
+    from smk_tpu.models.probit_gp import SpatialGPSampler
+    from smk_tpu.parallel.executor import (
+        DATA_AXES,
+        init_subset_states,
+        stacked_subset_data,
+        subset_chain_keys,
+    )
+    from smk_tpu.parallel.partition import random_partition
+    from smk_tpu.utils.tracing import device_sync
+
+    y, x, coords = make_binary_field(jax.random.key(seed), n, q=q, p=2)
+    part = random_partition(jax.random.key(1), y, x, coords, k)
+    m = part.x.shape[1]
+    data = stacked_subset_data(part, coords[:4], x[:4])
+    keys = subset_chain_keys(jax.random.key(2), k, 1)
+    # sweeps are [1, n_iters] so "no updates" is expressible as
+    # phi_update_every = n_iters + 2 (sweep 0 would always update)
+    start_it = 1
+    n_updates = sum(
+        1
+        for i in range(start_it, start_it + n_iters)
+        if i % phi_update_every == 0
+    )
+    base = SMKConfig(
+        n_subsets=k, n_samples=max(n_iters, 2), burn_in_frac=0.5,
+        phi_sampler="collapsed", u_solver=u_solver, cg_iters=8,
+        phi_update_every=phi_update_every,
+    )
+
+    def timed_counts(cfg):
+        # NOT executor.count_subset_factorizations (the documented
+        # counting entry point): that helper compiles internally and
+        # exposes no warm re-run, and this measurement needs a timed
+        # SECOND execution of the same compiled program so wall_s is
+        # execution, not compile. Same program otherwise — if the
+        # counting contract grows a field, change both sites.
+        model = SpatialGPSampler(cfg, weight=1)
+        init = init_subset_states(model, keys, data, None)
+        fn = jax.jit(
+            jax.vmap(
+                lambda d, s: model.count_chunk(
+                    d, s, start_it, n_iters, with_calls=True
+                ),
+                in_axes=(DATA_AXES, 0),
+            )
+        )
+        out = fn(data, init)
+        device_sync(out)  # compile + warm
+        t0 = time.time()
+        out = fn(data, init)
+        device_sync(out)
+        state, (n_chol, n_calls) = out
+        return (
+            np.asarray(state.phi_accept),
+            np.asarray(n_chol),
+            np.asarray(n_calls),
+            time.time() - t0,
+        )
+
+    u_draw = 1 if u_solver == "chol" else 0
+    cells = []
+    for j_try in j_tries:
+        fam = "gaussian" if j_try == 1 else family
+        cfg = dataclasses.replace(
+            base, phi_proposals=j_try, phi_proposal_family=fam
+        )
+        accepts, n_chol, n_calls, wall = timed_counts(cfg)
+        _, _, _, wall0 = timed_counts(
+            dataclasses.replace(cfg, phi_update_every=n_iters + 2)
+        )
+        acc = accepts.sum(axis=-1).astype(int)  # (K,) accepted moves
+        per_upd_logical = 2 if j_try == 1 else 2 * j_try
+        per_upd_calls = 2
+        exp_logical = q * (
+            per_upd_logical * n_updates + u_draw * (n_iters - n_updates)
+        ) + acc
+        exp_calls = q * (
+            per_upd_calls * n_updates + u_draw * (n_iters - n_updates)
+        ) + acc
+        upd_s = max(wall - wall0, 1e-9)
+        # update-ATTRIBUTED work only (the achieved rate covers the
+        # proposal-side stacks plus the accept-side R(phi') refresh).
+        # The differencing is EXACT only on the cg path, where
+        # non-update sweeps factor nothing: on the dense path an
+        # update sweep REUSES the selected factor (thread_s) while
+        # the zero-update baseline builds S on every sweep, so
+        # wall - wall0 under-measures the update cost by U u-draw
+        # factorizations and would inflate the rate — the chol cells
+        # therefore carry counts + walls but NO per_call_gflops
+        # (isolation_exact says why).
+        upd_logical = int(
+            (q * per_upd_logical * n_updates + acc).sum()
+        )
+        upd_calls = int((q * per_upd_calls * n_updates + acc).sum())
+        isolation_exact = u_solver == "cg"
+        cells.append({
+            "J": j_try,
+            "family": fam,
+            "accepted_updates_per_subset": [int(a) for a in acc],
+            "n_chol_per_subset": [int(v) for v in n_chol],
+            "n_chol_calls_per_subset": [int(v) for v in n_calls],
+            "batched_calls_per_update_sweep": per_upd_calls,
+            "logical_factorizations_per_update_sweep": per_upd_logical,
+            "wall_s": round(wall, 3),
+            "wall_s_no_update": round(wall0, 3),
+            "phi_update_s": round(upd_s, 3),
+            "update_logical_factorizations": upd_logical,
+            "update_batched_calls": upd_calls,
+            "isolation_exact": isolation_exact,
+            "per_call_gflops": (
+                round(upd_logical * (m**3 / 3) / upd_s / 1e9, 2)
+                if isolation_exact
+                else None
+            ),
+            "counts_match_protocol": bool(
+                np.all(n_chol == exp_logical)
+                and np.all(n_calls == exp_calls)
+            ),
+        })
+    return {
+        "rung": "mtm_probe",
+        "m": m, "K": k, "q": q, "u_solver": u_solver,
+        "phi_sampler": "collapsed",
+        "phi_update_every": phi_update_every,
+        "n_sweeps": n_iters, "n_update_sweeps": n_updates,
+        "counts_are_logical": True,
+        "cells": cells,
+    }
 
 
 def _probe_backend(attempts, wait_s):
@@ -1155,6 +1355,20 @@ def main():
              cov_model="matern32", n_samples=n_samples,
              n_chains=chains, phi_every=8,
              chunk_size=16 if chains > 1 else None),
+        # VERDICT r5 item 3: the flagship config5 shape has never
+        # shipped cross-chain diagnostics — a TRUE 2-chain rung at
+        # m=3906 (config3-style K-chunking bounds the 2-chain state
+        # in HBM) at a reduced iteration budget: cross-chain
+        # split-R-hat is the deliverable, and it is a statement about
+        # THESE chains at THIS budget (the record carries the note
+        # so the reduced budget cannot be misread as the full-budget
+        # fit; ESS-per-sec fields remain budget-comparable only
+        # within this rung). Last in the ladder: the gate drops it
+        # before it can starve the established rungs.
+        dict(name="config5_crosschain", public=True, n=32 * 3906,
+             k=32, cov_model="exponential",
+             n_samples=max(2000, n_samples * 2 // 5), n_chains=2,
+             phi_every=16, chunk_size=16),
     ]
     if ladder_mode != "full":
         rungs = [r for r in rungs if r["name"] == "config2"]
@@ -1202,6 +1416,12 @@ def main():
                     if is_north_star
                     else None,
                 )
+            if name == "config5_crosschain":
+                record["note"] = (
+                    "reduced-iteration 2-chain rung: param_rhat_max "
+                    "is TRUE cross-chain split-R-hat at m=3906; "
+                    "rates are not comparable to full-budget rungs"
+                )
             if name == "config5_api_parity":
                 head = {r.get("rung"): r for r in reporter.ladder}.get(
                     "config5_slice"
@@ -1235,6 +1455,18 @@ def main():
         except Exception as e:
             reporter.ladder.append(
                 {"rung": "factor_reuse_probe", "error": repr(e)}
+            )
+            reporter.emit(partial=True)
+
+    # Multi-try phi protocol record (ISSUE 2): batched-call vs
+    # logical factorization counts + isolated per-update wall for a
+    # J sweep — same budget/fallibility policy as the factor probe.
+    if left() > 90 and os.environ.get("BENCH_MTM_PROBE", "1") != "0":
+        try:
+            reporter.add_rung(measure_mtm())
+        except Exception as e:
+            reporter.ladder.append(
+                {"rung": "mtm_probe", "error": repr(e)}
             )
             reporter.emit(partial=True)
 

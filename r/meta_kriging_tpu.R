@@ -56,6 +56,10 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
                                 combiner = "wasserstein_mean",
                                 link = c("probit", "logit"),
                                 k.prior = c("invwishart", "normal"),
+                                phi.proposals = 1L,
+                                phi.proposal.family = c("gaussian",
+                                                        "student_t",
+                                                        "mixture"),
                                 n.report = NULL,
                                 checkpoint.path = NULL,
                                 backend = c("tpu", "cpu"),
@@ -73,11 +77,22 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
   # 2-4 posterior sd, SMK_QUALITY_r05.jsonl) — the Python backend
   # emits a warning when a q >= 2 fit is tempered; leave temper =
   # "none" (the default) for multivariate data.
+  # phi.proposals / phi.proposal.family: the multi-try collapsed-phi
+  # engine (SMKConfig.phi_proposals): J > 1 evaluates J candidate
+  # range updates per move from ONE batched (J+1, m, m) Cholesky and
+  # accepts by the multiple-try Metropolis ratio — the mixing lever
+  # for slow-phi fits (Matern-3/2 above all; see the README's
+  # multi-try section and PHI_MTM_r06.jsonl). "student_t"/"mixture"
+  # put proposal mass at several scales at once. phi.proposals > 1
+  # requires the collapsed sampler (config.overrides = list(
+  # phi_sampler = "collapsed")); the default 1/"gaussian" is the
+  # classic single-try chain bit-exactly.
   # n.report: if set, progress is printed every n.report iterations
   # (the reference's n.report batch printouts, R:84) — the fit then
   # runs through the chunked executor. checkpoint.path: if set, the
   # fit checkpoints each chunk and an interrupted call resumes.
   k.prior <- match.arg(k.prior)
+  phi.proposal.family <- match.arg(phi.proposal.family)
   # link: the reference workflow is logit (spMvGLM binomial fit,
   # 1/(1+exp(-eta)) at MetaKriging_BinaryResponse.R:160); the TPU
   # default is the exact Albert–Chib probit sampler. Users porting the
@@ -123,6 +138,8 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
     cov_model = cov.model,
     combiner = combiner,
     link = link,
+    phi_proposals = as.integer(phi.proposals),
+    phi_proposal_family = phi.proposal.family,
     priors = smk$PriorConfig(a_prior = k.prior)
   ), config.overrides)
   cfg <- do.call(smk$SMKConfig, cfg_args)

@@ -213,6 +213,11 @@ def fit_meta_kriging(
     # all four q=2 cells fail the tempered quality gate) — warn here,
     # the first point in the pipeline where q is known
     cfg.warn_if_tempered_multivariate(q)
+    # multi-try phi (phi_proposals > 1): the batched (J+1, m, m)
+    # proposal workspace scales with the subset size the partitioner
+    # is about to produce (ceil(n/K) — random_partition pads the
+    # remainder) — warn before committing device memory to the fit
+    cfg.warn_if_mtm_workspace_large(-(-n // cfg.n_subsets))
     if x.ndim != 3 or x.shape[:2] != (n, q):
         raise ValueError(
             f"x must be (n={n}, q={q}, p) designs, got shape {x.shape}"

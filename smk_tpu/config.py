@@ -18,6 +18,7 @@ import dataclasses
 COV_MODELS = ("exponential", "matern32", "matern52")
 LINKS = ("probit", "logit")
 COMBINERS = ("wasserstein_mean", "weiszfeld_median")
+PHI_PROPOSAL_FAMILIES = ("gaussian", "student_t", "mixture")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -184,6 +185,42 @@ class SMKConfig:
     #   headroom).
     phi_sampler: str = "conditional"
 
+    # Multiple-try Metropolis for the COLLAPSED phi update (Liu,
+    # Liang & Wong 2000): each update draws J = phi_proposals
+    # candidates from the random-walk kernel on the transformed scale,
+    # evaluates ALL their collapsed marginals from ONE batched
+    # (J+1, m, m) Cholesky (candidates + current share the build —
+    # ops/kernels.py correlation_stack feeding ops/chol.py
+    # batched_shifted_cholesky, the MXU-saturating shape), selects a
+    # candidate by importance weight, and accepts with the MTM ratio
+    # (a second batched (J-1, m, m) call evaluates the reference set
+    # drawn around the selected candidate — the symmetric-kernel
+    # "MTM II" form, which at J=1 IS plain Metropolis). Two knobs:
+    # - phi_proposals (J, default 1): 1 keeps today's two sequential
+    #   factorization chains bit-identically (the MTM code path is
+    #   not even traced); J >= 2 trades 2J logical factorizations per
+    #   update (vs 2-3) issued as TWO batched calls for proposal-
+    #   design freedom and a much higher chance of a good move —
+    #   the mixing lever for slow-phi configs (config3/Matern-3/2,
+    #   CROSSCHAIN_CONFIG3_r05: the frequency lever is measured-
+    #   rejected). Collapsed sampler only (validated).
+    # - phi_proposal_family: the shared shape of the J proposal
+    #   increments on the logit-transformed scale. "gaussian" is the
+    #   classic RW kernel; "student_t" (df=3) and "mixture" (half
+    #   N(0, step^2), half N(0, (8*step)^2)) put mass at several
+    #   scales at once, so one MTM draw probes local refinement AND
+    #   mode-hopping jumps — the heavy-tail proposal-design fix from
+    #   the r5 shortlist. All three are symmetric, which is what the
+    #   J+1-evaluation MTM weight form requires. At J=1 the family
+    #   still applies to the single RW increment (gaussian = today's
+    #   chain bit-exactly).
+    # Memory: the batched build holds ~2(J+1) m^2 fp32 workspaces
+    # live at once where the sequential path barrier-kept ~2 — see
+    # mtm_workspace_bytes; api.fit_meta_kriging warns at fit time
+    # when the fan-out looks HBM-risky for the subset size.
+    phi_proposals: int = 1
+    phi_proposal_family: str = "gaussian"
+
     # Factor-reuse engine (ops/factor_cache.py): thread accepted
     # Cholesky factors through the Gibbs sweep instead of
     # re-factorizing. With the collapsed phi sampler, (a) the dense
@@ -327,7 +364,7 @@ class SMKConfig:
         "n_subsets", "n_samples", "n_chains", "n_quantiles",
         "resample_size", "weiszfeld_iters", "phi_update_every",
         "cg_iters", "cg_precond_rank", "chol_block_size",
-        "trisolve_block_size", "pg_n_terms",
+        "trisolve_block_size", "pg_n_terms", "phi_proposals",
     )
 
     def __post_init__(self):
@@ -398,6 +435,21 @@ class SMKConfig:
             raise ValueError(
                 "phi_sampler must be 'conditional' or 'collapsed'"
             )
+        if self.phi_proposals < 1:
+            raise ValueError("phi_proposals must be >= 1")
+        if self.phi_proposal_family not in PHI_PROPOSAL_FAMILIES:
+            raise ValueError(
+                "phi_proposal_family must be one of "
+                f"{PHI_PROPOSAL_FAMILIES}"
+            )
+        if self.phi_proposals > 1 and self.phi_sampler != "collapsed":
+            raise ValueError(
+                "phi_proposals > 1 (multiple-try Metropolis) is "
+                "implemented for phi_sampler='collapsed' only — the "
+                "conditional sampler's single proposal Cholesky is "
+                "already its whole cost and gains nothing from a "
+                "batched candidate set"
+            )
         if not isinstance(self.factor_reuse, bool):
             raise ValueError(
                 f"factor_reuse must be a bool, got {self.factor_reuse!r}"
@@ -446,6 +498,46 @@ class SMKConfig:
                 "SMK_QUALITY_r05.jsonl). Tempering is validated for "
                 "q=1 only — prefer priors.temper='none' for "
                 "multivariate fits.",
+                UserWarning,
+                stacklevel=3,
+            )
+
+    def mtm_workspace_bytes(self, m: int) -> int:
+        """Peak extra fp32 workspace of one multi-try phi update at
+        subset size ``m``: the forward (J+1, m, m) correlation stack
+        and its factor are live together (the reverse (J-1, m, m)
+        batch allocates only after a barrier kills them, so the
+        forward pair is the peak). Zero when phi_proposals == 1 —
+        the sequential path's barrier-sequenced ~2 m^2 buffers are
+        the pre-MTM status quo, not an MTM cost."""
+        j = self.phi_proposals
+        if j <= 1:
+            return 0
+        return 2 * (j + 1) * m * m * 4
+
+    def warn_if_mtm_workspace_large(
+        self, m: int, *, budget_bytes: int = 2 * 1024**3
+    ) -> None:
+        """Warn when the MTM proposal fan-out's batched workspace at
+        subset size ``m`` exceeds ``budget_bytes`` (default 2 GiB —
+        a conservative share of a 16 GB v5e once the carried
+        (q, m, m) state and the K-vmap axis are accounted). Called by
+        api.fit_meta_kriging once m is known; purely advisory (the
+        fit proceeds — lower J, raise n_subsets, or chunk K)."""
+        ws = self.mtm_workspace_bytes(m)
+        if ws > budget_bytes:
+            import warnings
+
+            warnings.warn(
+                f"phi_proposals={self.phi_proposals} at subset size "
+                f"m={m} holds a ~{ws / 1e9:.1f} GB batched proposal "
+                "workspace per component during each collapsed phi "
+                "update (2(J+1) m^2 fp32 buffers live at once; see "
+                "SMKConfig.mtm_workspace_bytes). With the K-vmapped "
+                "executor this multiplies across concurrently "
+                "updating subsets — consider a smaller "
+                "phi_proposals, more/smaller subsets, or chunk_size "
+                "to bound resident K.",
                 UserWarning,
                 stacklevel=3,
             )

@@ -62,6 +62,7 @@ from jax import lax
 
 from smk_tpu.config import SMKConfig
 from smk_tpu.ops.chol import (
+    batched_shifted_cholesky,
     blocked_cholesky,
     blocked_tri_solve,
     chol_logdet,
@@ -86,10 +87,11 @@ from smk_tpu.ops.cg import (
     shifted_correlation_operator,
 )
 from smk_tpu.ops.distance import cross_distance, pairwise_distance
-from smk_tpu.ops.kernels import correlation
+from smk_tpu.ops.kernels import correlation, correlation_stack
 from smk_tpu.ops.polya_gamma import sample_pg
 from smk_tpu.ops.quantiles import quantile_grid
 from smk_tpu.ops.truncnorm import sample_albert_chib_latent
+from smk_tpu.utils.tracing import mtm_chol_scope
 
 # jax 0.4.x ships no batching rule for lax.optimization_barrier, so
 # any vmapped program containing the collapsed sampler's barrier-
@@ -187,6 +189,15 @@ def n_params(q: int, p: int) -> int:
     return q * p + q * (q + 1) // 2 + q
 
 
+def _pad_identity(r, mask):
+    """R~ = M R M + (I - M), M = diag(mask) — the ONE site owning the
+    pad-row treatment (see masked_correlation); broadcasts over any
+    leading stack axes of ``r``."""
+    mm = mask[:, None] * mask[None, :]  # (m, m)
+    eye = jnp.eye(mask.shape[0], dtype=r.dtype)
+    return mm * r + (1.0 - mm) * eye
+
+
 def masked_correlation(dist, phi, mask, model):
     """Correlation with padded rows made *exactly* inert.
 
@@ -201,10 +212,49 @@ def masked_correlation(dist, phi, mask, model):
 
     dist: (..., m, m); phi broadcastable against it; mask: (m,).
     """
-    r = correlation(dist, phi, model)
-    mm = mask[:, None] * mask[None, :]  # (m, m)
-    eye = jnp.eye(mask.shape[0], dtype=r.dtype)
-    return mm * r + (1.0 - mm) * eye
+    return _pad_identity(correlation(dist, phi, model), mask)
+
+
+def masked_correlation_stack(dist, phis, mask, model):
+    """:func:`masked_correlation` for a stacked (s,) phi candidate
+    vector — the multi-try engine's one-call build: s correlation
+    matrices from a single fused read of the distance matrix
+    (ops/kernels.correlation_stack) with the pad-row identity
+    treatment (_pad_identity — shared with masked_correlation)
+    broadcast across the stack. dist: (m, m); phis: (s,); mask:
+    (m,). Returns (s, m, m)."""
+    return _pad_identity(correlation_stack(dist, phis, model), mask)
+
+
+# Multi-try proposal families (SMKConfig.phi_proposal_family): the
+# shared increment distribution on the logit-transformed scale.
+# Symmetry around zero is load-bearing — the MTM-II weight form in
+# collapsed_phi_block drops the proposal density from the importance
+# weights only because q(a | b) = q(b | a) for every family here.
+_MTM_T_DF = 3.0  # student_t: heavy tails, finite variance at df=3
+_MTM_MIX_WIDE = 8.0  # mixture: the wide component's scale multiplier
+
+
+def mtm_proposal_eps(key, shape, dtype, family):
+    """Draw symmetric proposal increments for the (multi-try) phi
+    random walk. "gaussian" reproduces the historical single-try
+    draw bit-exactly (same key, same primitive); "student_t" and
+    "mixture" put proposal mass at several scales at once so one
+    MTM candidate set probes local refinement AND long jumps."""
+    if family == "gaussian":
+        return jax.random.normal(key, shape, dtype)
+    if family == "student_t":
+        return jax.random.t(key, _MTM_T_DF, shape, dtype)
+    # 50/50 scale mixture: N(0, 1) locals and N(0, _MTM_MIX_WIDE^2)
+    # jumps (both pre-multiplied by the adapted step at the call site)
+    kz, kc = jax.random.split(key)
+    z = jax.random.normal(kz, shape, dtype)
+    wide = jax.random.bernoulli(kc, 0.5, shape)
+    return z * jnp.where(
+        wide,
+        jnp.asarray(_MTM_MIX_WIDE, dtype),
+        jnp.asarray(1.0, dtype),
+    )
 
 
 class SpatialGPSampler:
@@ -328,6 +378,7 @@ class SpatialGPSampler:
         return FactorCache(
             r_mv=r_mv_p, nys_z=nys_p, chol_inv=inv_prop,
             krige_w=kw_p, krige_chol=kc_p, n_chol=cache.n_chol,
+            n_chol_calls=cache.n_chol_calls,
         )
 
     def _solve_cache(
@@ -365,7 +416,7 @@ class SpatialGPSampler:
         return FactorCache(
             r_mv=r_mv, nys_z=nys_z, chol_inv=chol_inv,
             krige_w=krige_w, krige_chol=krige_chol,
-            n_chol=empty_counter(),
+            n_chol=empty_counter(), n_chol_calls=empty_counter(),
         )
 
     # ------------------------------------------------------------------
@@ -522,7 +573,8 @@ class SpatialGPSampler:
                     cfg.cov_model,
                 )
                 chol_prop = self._chol_r(r_prop)
-            cache2 = tick(cache, q)  # the (q, m, m) proposal factor
+            cache2 = tick(cache, q, n_calls=1)  # ONE batched
+            # (q, m, m) proposal-factor call, q logical factorizations
             inv_cur = cache.chol_inv
             inv_prop = (
                 self._chol_inv(chol_prop)
@@ -653,13 +705,6 @@ class SpatialGPSampler:
                 phi_j = phi[j]
                 step = jnp.exp(state.phi_log_step[j])
                 t_cur = jnp.log((phi_j - lo) / (hi - phi_j))
-                eps = jax.random.normal(
-                    jax.random.fold_in(kprop, j), (), dtype
-                )
-                t_prop = t_cur + step * eps
-                sig_cur = jax.nn.sigmoid(t_cur)
-                sig_prop = jax.nn.sigmoid(t_prop)
-                phi_prop = lo + (hi - lo) * sig_prop
 
                 def marg_ll(phi_v):
                     # the marginal's S = R~(phi) + jit I + D: pad rows
@@ -678,47 +723,183 @@ class SpatialGPSampler:
                     )
                     return ll, r, chol_s
 
-                # The three m^2 workspaces of a collapsed update
-                # (S_cur, S_prop, R_prop factor chains) must NOT be
-                # live at once: XLA schedules the two marg_ll chains
-                # concurrently and the resulting peak exceeds v5e HBM
-                # by ~300 MB at the config-5 slice (measured OOM).
-                # The barriers sequence cur -> prop -> refresh so each
-                # chain's temporaries die before the next allocates.
-                # (thread_s retains the cur S-factor through the prop
-                # chain — one extra live m^2 buffer, taken only on
-                # the dense small-m path, never at cg/bench scale.)
-                cache = tick(cache, 2)  # S_cur and S_prop
-                ll_cur, _, chol_s_cur = marg_ll(phi_j)
-                if thread_s:
-                    ll_cur, chol_s_cur, phi_prop = (
-                        lax.optimization_barrier(
-                            (ll_cur, chol_s_cur, phi_prop)
+                if cfg.phi_proposals == 1:
+                    # ---- single-try path: the historical collapsed
+                    # RW-MH, kept bit-identically (the MTM machinery
+                    # below is not even traced at J=1 — golden chains
+                    # and the factor-reuse tests pin this).
+                    eps = mtm_proposal_eps(
+                        jax.random.fold_in(kprop, j), (), dtype,
+                        cfg.phi_proposal_family,
+                    )
+                    t_prop = t_cur + step * eps
+                    sig_cur = jax.nn.sigmoid(t_cur)
+                    sig_prop = jax.nn.sigmoid(t_prop)
+                    phi_prop = lo + (hi - lo) * sig_prop
+                    # The three m^2 workspaces of a collapsed update
+                    # (S_cur, S_prop, R_prop factor chains) must NOT
+                    # be live at once: XLA schedules the two marg_ll
+                    # chains concurrently and the resulting peak
+                    # exceeds v5e HBM by ~300 MB at the config-5
+                    # slice (measured OOM). The barriers sequence
+                    # cur -> prop -> refresh so each chain's
+                    # temporaries die before the next allocates.
+                    # (thread_s retains the cur S-factor through the
+                    # prop chain — one extra live m^2 buffer, taken
+                    # only on the dense small-m path, never at
+                    # cg/bench scale.)
+                    cache = tick(cache, 2)  # S_cur and S_prop
+                    ll_cur, _, chol_s_cur = marg_ll(phi_j)
+                    if thread_s:
+                        ll_cur, chol_s_cur, phi_prop = (
+                            lax.optimization_barrier(
+                                (ll_cur, chol_s_cur, phi_prop)
+                            )
                         )
+                    else:
+                        chol_s_cur = None
+                        ll_cur, phi_prop = lax.optimization_barrier(
+                            (ll_cur, phi_prop)
+                        )
+                    ll_prop, r_prop, chol_s_prop = marg_ll(phi_prop)
+                    if thread_s:
+                        ll_prop, r_prop, chol_s_prop = (
+                            lax.optimization_barrier(
+                                (ll_prop, r_prop, chol_s_prop)
+                            )
+                        )
+                    else:
+                        chol_s_prop = None
+                        ll_prop, r_prop = lax.optimization_barrier(
+                            (ll_prop, r_prop)
+                        )
+                    log_ratio = (
+                        ll_prop
+                        + jnp.log(sig_prop * (1.0 - sig_prop))
+                        - ll_cur
+                        - jnp.log(sig_cur * (1.0 - sig_cur))
                     )
                 else:
-                    chol_s_cur = None
-                    ll_cur, phi_prop = lax.optimization_barrier(
-                        (ll_cur, phi_prop)
+                    # ---- multiple-try path (Liu, Liang & Wong 2000,
+                    # the symmetric-kernel "MTM II" form, which at
+                    # J=1 IS plain Metropolis — hence the branch
+                    # above). All J candidate marginals come from ONE
+                    # batched (J+1, m, m) build+factor — candidates
+                    # and the current point share the build because
+                    # the diagonal shift D is phi-free — instead of
+                    # J+1 sequential m^3 dependency chains; the
+                    # accept ratio costs one more (J-1, m, m) batched
+                    # call for the reference set drawn around the
+                    # selected candidate. Counted as 2 batched calls
+                    # vs 2J logical factorizations (FactorCache
+                    # n_chol/n_chol_calls).
+                    j_try = cfg.phi_proposals
+                    k_eps, k_sel, k_rev = jax.random.split(
+                        jax.random.fold_in(kprop, j), 3
                     )
-                ll_prop, r_prop, chol_s_prop = marg_ll(phi_prop)
-                if thread_s:
-                    ll_prop, r_prop, chol_s_prop = (
-                        lax.optimization_barrier(
-                            (ll_prop, r_prop, chol_s_prop)
+                    eps = mtm_proposal_eps(
+                        k_eps, (j_try,), dtype,
+                        cfg.phi_proposal_family,
+                    )
+                    t_props = t_cur + step * eps
+                    phi_props = (
+                        lo + (hi - lo) * jax.nn.sigmoid(t_props)
+                    )
+
+                    def stack_logw(t_vec, phi_vec):
+                        # log MTM weight of each point: collapsed
+                        # marginal (u_j integrated out) + transform
+                        # Jacobian — the target density on the t
+                        # scale (the symmetric proposal densities
+                        # cancel, Liu et al.'s w(x, y) = pi(x)
+                        # choice). Non-finite values (fp32
+                        # factorization failure) become -inf: zero
+                        # selection probability and zero mass in the
+                        # weight sums — the MTM form of the
+                        # finite-factor guard.
+                        with mtm_chol_scope():
+                            r_stk = masked_correlation_stack(
+                                dist, phi_vec, mask, cfg.cov_model
+                            )
+                            chol_stk = batched_shifted_cholesky(
+                                r_stk, shift
+                            )
+                        yt = jnp.broadcast_to(
+                            ytilde,
+                            (phi_vec.shape[0],) + ytilde.shape,
                         )
+                        alpha = self._tri(chol_stk, yt)
+                        ll = -0.5 * jnp.sum(
+                            alpha * alpha, axis=-1
+                        ) - 0.5 * chol_logdet(chol_stk)
+                        sig = jax.nn.sigmoid(t_vec)
+                        lw = ll + jnp.log(sig * (1.0 - sig))
+                        return (
+                            jnp.where(
+                                jnp.isfinite(lw), lw, -jnp.inf
+                            ),
+                            r_stk,
+                            chol_stk,
+                        )
+
+                    t_stack = jnp.concatenate([t_cur[None], t_props])
+                    phi_stack = jnp.concatenate(
+                        [phi_j[None], phi_props]
                     )
-                else:
-                    chol_s_prop = None
-                    ll_prop, r_prop = lax.optimization_barrier(
-                        (ll_prop, r_prop)
+                    lw_stack, r_stack, chol_stack = stack_logw(
+                        t_stack, phi_stack
                     )
-                log_ratio = (
-                    ll_prop
-                    + jnp.log(sig_prop * (1.0 - sig_prop))
-                    - ll_cur
-                    - jnp.log(sig_cur * (1.0 - sig_cur))
-                )
+                    cache = tick(cache, j_try + 1, n_calls=1)
+                    lw_cur, lw_fwd = lw_stack[0], lw_stack[1:]
+                    # candidate selection by importance weight (an
+                    # all--inf weight vector degenerates to index 0,
+                    # which the -inf forward sum then rejects)
+                    k_idx = jax.random.categorical(k_sel, lw_fwd)
+                    phi_prop = phi_stack[k_idx + 1]
+                    t_sel = t_stack[k_idx + 1]
+                    r_prop = r_stack[k_idx + 1]
+                    # barrier: only the selected slices survive —
+                    # the (J+1) m^2 forward workspaces must die
+                    # before the reference batch allocates (the same
+                    # HBM discipline as the sequential path, batched)
+                    if thread_s:
+                        chol_s_cur = chol_stack[0]
+                        chol_s_prop = chol_stack[k_idx + 1]
+                        (
+                            lw_fwd, lw_cur, phi_prop, t_sel, r_prop,
+                            chol_s_cur, chol_s_prop,
+                        ) = lax.optimization_barrier((
+                            lw_fwd, lw_cur, phi_prop, t_sel, r_prop,
+                            chol_s_cur, chol_s_prop,
+                        ))
+                    else:
+                        chol_s_cur = chol_s_prop = None
+                        (lw_fwd, lw_cur, phi_prop, t_sel, r_prop) = (
+                            lax.optimization_barrier((
+                                lw_fwd, lw_cur, phi_prop, t_sel,
+                                r_prop,
+                            ))
+                        )
+                    # reference set: J-1 fresh draws from the same
+                    # kernel centered at the SELECTED candidate; the
+                    # current point is the J-th reference point and
+                    # its weight is already in hand from the forward
+                    # stack.
+                    eps_rev = mtm_proposal_eps(
+                        k_rev, (j_try - 1,), dtype,
+                        cfg.phi_proposal_family,
+                    )
+                    t_rev = t_sel + step * eps_rev
+                    phi_rev = (
+                        lo + (hi - lo) * jax.nn.sigmoid(t_rev)
+                    )
+                    lw_rev, _, _ = stack_logw(t_rev, phi_rev)
+                    cache = tick(cache, j_try - 1, n_calls=1)
+                    log_ratio = jax.nn.logsumexp(
+                        lw_fwd
+                    ) - jax.nn.logsumexp(
+                        jnp.concatenate([lw_rev, lw_cur[None]])
+                    )
                 accept_mh = (
                     jnp.log(
                         jax.random.uniform(
@@ -1228,6 +1409,7 @@ class SpatialGPSampler:
         n_iters: int,
         *,
         collect: bool = False,
+        with_calls: bool = False,
     ):
         """Instrumented non-collecting scan: advance ``n_iters`` Gibbs
         sweeps from ``state`` and return ``(state, n_chol)`` where
@@ -1240,6 +1422,13 @@ class SpatialGPSampler:
         the state advances exactly as burn_chunk's would
         (``collect=False``) or sample_chunk's (``collect=True``,
         draws discarded), so counts attach to a real chain.
+
+        ``with_calls=True`` returns ``(state, (n_chol,
+        n_chol_calls))`` instead — the second counter is the number
+        of batched Cholesky CALLS issued (one batched (J+1, m, m)
+        MTM factorization = 1 call, J+1 logical), the measurement
+        behind the multi-try protocol (scripts/mtm_probe.py,
+        PHI_MTM_*.jsonl).
         """
         cfg = self.config
         with jax.default_matmul_precision(cfg.matmul_precision):
@@ -1256,6 +1445,8 @@ class SpatialGPSampler:
             (state, cache), _ = lax.scan(
                 step, (state, cache), start_it + jnp.arange(n_iters)
             )
+            if with_calls:
+                return state, (cache.n_chol, cache.n_chol_calls)
             return state, cache.n_chol
 
     def sample_chunk(

@@ -121,6 +121,33 @@ def shifted_cholesky(r: jnp.ndarray, shift: jnp.ndarray) -> jnp.ndarray:
     return jnp.tril(lax.linalg.cholesky(r + shift[..., None] * eye))
 
 
+def batched_shifted_cholesky(
+    r_stack: jnp.ndarray, shift: jnp.ndarray
+) -> jnp.ndarray:
+    """Factor a STACK of shifted correlations in one batched call —
+    the multi-try phi engine's hot kernel (models/probit_gp.py): the
+    J proposal matrices plus the current one arrive as a
+    (J+1, m, m) stack sharing the same diagonal shift (D depends on
+    omega/A, not phi), and XLA lowers the single batched cholesky to
+    MXU-tiled kernels instead of J+1 sequential m^3 dependency
+    chains. Each batch element's factorization is bit-identical to
+    :func:`shifted_cholesky` of that element alone (same addition
+    order, same kernel — only the batch dimension differs), which is
+    what lets the selected factor feed the factor-reuse engine's
+    u-draw contract unchanged.
+
+    r_stack: (..., s, m, m); shift: scalar or (m,)/(..., m) positive
+    diagonal, broadcast across the stack axis. Counted as ONE batched
+    call / s logical factorizations in the FactorCache accounting
+    (ops/factor_cache.py tick).
+    """
+    shift = jnp.zeros(r_stack.shape[:-1], r_stack.dtype) + shift
+    eye = jnp.eye(r_stack.shape[-1], dtype=r_stack.dtype)
+    return jnp.tril(
+        lax.linalg.cholesky(r_stack + shift[..., None] * eye)
+    )
+
+
 def finite_factor(chol_l: jnp.ndarray) -> jnp.ndarray:
     """Scalar bool per batch element: every diagonal entry of the
     factor finite — the fp32 accept guard of the collapsed sampler

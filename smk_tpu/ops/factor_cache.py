@@ -40,6 +40,17 @@ Reuse contract (``SMKConfig.factor_reuse``, default on):
 engine exists to eliminate. The O(p^3)/O(t^3) factorizations of the
 beta/A/krige-conditional updates are noise at scale and are not
 counted.
+
+Since the multi-try engine (SMKConfig.phi_proposals) the counter is a
+PAIR: ``n_chol`` keeps counting *logical* m x m factorizations (the
+protocol number the factor-reuse records assert on — unchanged
+semantics), while ``n_chol_calls`` counts *batched Cholesky calls* —
+the number of distinct factorization kernels issued, where one
+batched ``(J+1, m, m)`` call is ONE call but J+1 logical
+factorizations. The gap between the two is the measured batching win
+of the MTM engine (one MXU-saturating call instead of J sequential
+m^3 dependency chains); ``scripts/mtm_probe.py`` and bench.py's MTM
+record report both.
 """
 
 from __future__ import annotations
@@ -84,6 +95,10 @@ class FactorCache(NamedTuple):
            reports the logical factorization count per sweep (the
            protocol number bench.py and the factor-reuse tests
            assert on).
+    n_chol_calls: () int32 — running count of batched Cholesky CALLS
+           (kernel issues): a batched (J+1, m, m) factorization adds
+           J+1 to ``n_chol`` but 1 here. Same instrumentation-only
+           contract as ``n_chol``.
     """
 
     r_mv: Optional[jnp.ndarray]
@@ -92,6 +107,7 @@ class FactorCache(NamedTuple):
     krige_w: Optional[jnp.ndarray] = None
     krige_chol: Optional[jnp.ndarray] = None
     n_chol: jnp.ndarray = None  # type: ignore[assignment]
+    n_chol_calls: jnp.ndarray = None  # type: ignore[assignment]
 
 
 def empty_counter() -> jnp.ndarray:
@@ -99,7 +115,7 @@ def empty_counter() -> jnp.ndarray:
     return jnp.zeros((), jnp.int32)
 
 
-def tick(cache: FactorCache, n: int) -> FactorCache:
+def tick(cache: FactorCache, n: int, n_calls: int | None = None) -> FactorCache:
     """Record ``n`` m x m factorizations on the carried counter.
 
     ``n`` is a static Python int (the count is structural per site:
@@ -107,8 +123,18 @@ def tick(cache: FactorCache, n: int) -> FactorCache:
     one); call sites inside a lax.cond branch are counted only when
     that branch runs, which is exactly the semantics the protocol
     measurement needs.
+
+    ``n_calls``: how many batched Cholesky CALLS those ``n`` logical
+    factorizations were issued as. Defaults to ``n`` (each logical
+    factorization its own kernel — the historical sequential sites);
+    the batched MTM/conditional sites pass 1.
     """
-    return cache._replace(n_chol=cache.n_chol + jnp.int32(n))
+    if n_calls is None:
+        n_calls = n
+    return cache._replace(
+        n_chol=cache.n_chol + jnp.int32(n),
+        n_chol_calls=cache.n_chol_calls + jnp.int32(n_calls),
+    )
 
 
 def select_accept(
@@ -134,6 +160,7 @@ def select_accept(
         krige_w=sel(prop.krige_w, cur.krige_w, 2),
         krige_chol=sel(prop.krige_chol, cur.krige_chol, 2),
         n_chol=prop.n_chol,
+        n_chol_calls=prop.n_chol_calls,
     )
 
 
@@ -157,4 +184,5 @@ def scatter_component(
         krige_w=sel_j(prop.krige_w, cur.krige_w),
         krige_chol=sel_j(prop.krige_chol, cur.krige_chol),
         n_chol=prop.n_chol,
+        n_chol_calls=prop.n_chol_calls,
     )

@@ -50,3 +50,19 @@ def correlation(dist: jnp.ndarray, phi: jnp.ndarray, model: str) -> jnp.ndarray:
             f"{sorted(CORRELATION_FNS)}"
         ) from None
     return fn(dist, phi)
+
+
+def correlation_stack(
+    dist: jnp.ndarray, phis: jnp.ndarray, model: str
+) -> jnp.ndarray:
+    """(s, m, m) correlation matrices for a vector of decay values in
+    ONE kernel call — the multi-try phi engine's candidate build
+    (models/probit_gp.py): the distance matrix is read once and the
+    elementwise kernel math broadcasts over the stacked phi axis, so
+    XLA emits a single fused elementwise kernel feeding the batched
+    Cholesky (ops/chol.py batched_shifted_cholesky) instead of s
+    separate build+factor chains.
+
+    dist: (m, m); phis: (s,).
+    """
+    return correlation(dist[None], phis[:, None, None], model)

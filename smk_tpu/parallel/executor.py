@@ -163,11 +163,16 @@ def count_subset_factorizations(
     n_iters: int,
     start_it: int = 0,
     collect: bool = False,
+    with_calls: bool = False,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Instrumented fan-out: advance every subset ``n_iters`` Gibbs
     sweeps and return ``(phi_accepts, n_chol)`` — per-subset (K, q)
     phi-acceptance counts and the per-subset (K,) count of m x m
-    Cholesky factorizations executed (FactorCache.n_chol).
+    Cholesky factorizations executed (FactorCache.n_chol). With
+    ``with_calls=True`` the second element becomes the pair
+    ``(n_chol, n_chol_calls)`` of per-subset (K,) arrays — logical
+    factorizations vs batched Cholesky calls issued (the multi-try
+    protocol's measured batching ratio, scripts/mtm_probe.py).
 
     This is the measurement entry point of the factor-reuse protocol
     (scripts/factor_reuse_probe.py, bench.py's factor_reuse record):
@@ -187,13 +192,14 @@ def count_subset_factorizations(
     counted = jax.jit(
         jax.vmap(
             lambda d, s: model.count_chunk(
-                d, s, start_it, n_iters, collect=collect
+                d, s, start_it, n_iters, collect=collect,
+                with_calls=with_calls,
             ),
             in_axes=(_DATA_AXES, 0),
         )
     )
-    state, n_chol = counted(data, init)
-    return state.phi_accept, n_chol
+    state, counts = counted(data, init)
+    return state.phi_accept, counts
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "subsets") -> Mesh:

@@ -17,6 +17,20 @@ import jax
 import numpy as np
 
 
+# Named profiler scope wrapping the multi-try phi engine's batched
+# proposal-side Cholesky (models/probit_gp.py). One module-level name
+# so profile consumers (scripts/profile_*.py, TRACE_SUMMARY records)
+# and the emitting site cannot drift: any eff_tflops movement
+# attributed to the MTM change shows up under exactly this scope.
+MTM_CHOL_SCOPE = "phi_mtm_batched_chol"
+
+
+def mtm_chol_scope():
+    """jax.named_scope for the MTM batched factorization — use as
+    ``with mtm_chol_scope():`` around the (J+1, m, m) build+factor."""
+    return jax.named_scope(MTM_CHOL_SCOPE)
+
+
 def device_sync(tree: Any) -> None:
     """Force real completion of every array in ``tree``.
 
