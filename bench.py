@@ -135,6 +135,57 @@ def make_binary_field(key, n, q=1, p=2, phi=6.0, n_features=256):
     return y, x, coords
 
 
+def fused_ab_fns(cov_model, mask, shift):
+    """The ONE definition of the fused-vs-XLA A/B program pair — the
+    masked+shifted (s, m, m) correlation-stack build into its batched
+    factor, as the collapsed/MTM hot loop runs it. Shared by
+    ``measure_fused_build`` (the TPU ``config5_fused_ab`` rung) and
+    scripts/fused_build_probe.py (the FUSED_BUILD_r07 protocol
+    record) so the bench rung and the record it corroborates can
+    never desynchronize. Returns ``(xla_build(dist, phis),
+    fused_build(coords, phis))``."""
+    from smk_tpu.models.probit_gp import masked_correlation_stack
+    from smk_tpu.ops.chol import batched_shifted_cholesky
+    from smk_tpu.ops.pallas_build import fused_masked_shifted_build
+
+    def xla_build(dist, phis):
+        r = masked_correlation_stack(dist, phis, mask, cov_model)
+        return batched_shifted_cholesky(r, shift)
+
+    def fused_build(coords, phis):
+        s_mat = fused_masked_shifted_build(
+            coords, phis, mask, shift, cov_model
+        )
+        return jnp.tril(jax.lax.linalg.cholesky(s_mat))
+
+    return xla_build, fused_build
+
+
+def timed_warm(fn, *args, reps=3):
+    """Average wall over ``reps`` warm executions: jit ONCE so the
+    reps hit the warm fastpath — re-wrapping per rep would bill
+    dispatch/cache-miss overhead to the kernel."""
+    from smk_tpu.utils.tracing import device_sync
+
+    jfn = jax.jit(fn)
+    device_sync(jfn(*args))  # compile + warm
+    t0 = time.time()
+    for _ in range(reps):
+        device_sync(jfn(*args))
+    return (time.time() - t0) / reps
+
+
+def _resolved_fused_build(cfg) -> str:
+    """The fused-build mode the sampler will ACTUALLY run for ``cfg``
+    — requested mode passed through the same availability resolution
+    SpatialGPSampler applies (ops/pallas_build.resolve_fused_build),
+    so bench records never stamp "pallas" (or model fused traffic)
+    for a run that fell back to the XLA path."""
+    from smk_tpu.ops.pallas_build import resolve_fused_build
+
+    return resolve_fused_build(getattr(cfg, "fused_build", "off"))
+
+
 def op_model(cfg, m, k, q, n_iters, n_kept, t):
     """Analytic FLOP / HBM-byte counts for the sampler's hot ops.
 
@@ -145,8 +196,28 @@ def op_model(cfg, m, k, q, n_iters, n_kept, t):
     derived utilizations conservative. Validated against a measured
     per-phase profile at m=3906 in PROFILE_SLICE_r03.jsonl (see
     BASELINE.md).
+
+    The byte count is PER-PHASE (parts["bytes_phases"]: build /
+    solve / chol / krige — the total is their exact sum, so the
+    historical aggregate is unchanged for fused_build="off"). The
+    build phase is every correlation-build's input stream: a 4*m^2
+    distance-matrix read per build event on the XLA path, or the
+    fused Pallas path's coordinate streams
+    (ops/pallas_build.build_bytes_model — the modeled fused saving is
+    exactly this read replacement; the factor-side traffic is
+    conservatively left identical).
     """
     mv_bytes = 2 if cfg.cg_matvec_dtype == "bfloat16" else 4
+    # model the RESOLVED mode, not the requested one — when Pallas is
+    # unavailable the sampler runs the XLA path, and a record modeling
+    # the ~18x-smaller fused reads would describe traffic that never
+    # happened (same resolution the sampler itself applies)
+    if _resolved_fused_build(cfg) == "pallas":
+        from smk_tpu.ops.pallas_build import build_bytes_model
+
+        build_read = build_bytes_model(m, 1, fused=True)["read_bytes"]
+    else:
+        build_read = 4 * m * m
     n_phi = sum(
         1 for i in range(n_iters) if i % cfg.phi_update_every == 0
     )
@@ -203,39 +274,47 @@ def op_model(cfg, m, k, q, n_iters, n_kept, t):
         krige_flops = per_comp * n_kept * (m * m * t + 2 * t * t * m)
     flops = cg_flops + ustar_flops + chol_flops + krige_flops
     # HBM traffic: matrix streams per CG step + carried reads; the
-    # solve-operator rebuild (dist read + r_mv write) happens only on
-    # phi updates now that the operators are cached across sweeps
+    # solve-operator rebuild (build-phase read + r_mv write) happens
+    # only on phi updates now that the operators are cached across
+    # sweeps. Accumulated per phase so the build's share is a
+    # first-class record field (build_hbm_gbps).
     if cfg.u_solver == "cg":
-        bytes_ = per_comp * n_iters * (
+        solve_b = per_comp * n_iters * (
             (cfg.cg_iters + 1) * mv_bytes * m * m  # CG + final matvec
             + 4 * m * m  # u_star: chol_r read
-        ) + per_comp * n_phi * (
-            4 * m * m  # dist read for the rebuild
+        )
+        build_b = per_comp * n_phi * (
+            build_read  # dist read (or fused coord streams)
             + mv_bytes * m * m  # r_mv write
         )
     else:
-        bytes_ = per_comp * n_iters * (
-            4 * m * m  # dist read for the (R + D) rebuild
-            + 3 * 4 * m * m  # Cholesky working set + solve reads
+        build_b = per_comp * n_iters * build_read  # (R + D) rebuild
+        solve_b = per_comp * n_iters * (
+            3 * 4 * m * m  # Cholesky working set + solve reads
             + 4 * m * m  # u_star: chol_r read
         )
     # phi-update working set (the collapsed sampler streams ~3x the
     # factor traffic per update), + the kriging factor reads: one
     # chol_r stream per kept draw uncached, or one per sampling-phase
     # phi update with the cached operators
-    bytes_ += per_comp * n_phi * (n_chol * 4 * 4 * m * m)
+    chol_b = per_comp * n_phi * (n_chol * 4 * 4 * m * m)
     if getattr(cfg, "krige_cache", False):
-        bytes_ += per_comp * n_phi_samp * (4 * m * m)
+        krige_b = per_comp * n_phi_samp * (4 * m * m)
     else:
-        bytes_ += per_comp * n_kept * (4 * m * m)
+        krige_b = per_comp * n_kept * (4 * m * m)
     if cfg.u_solver == "cg" and cfg.cg_precond == "nystrom":
         # Z streamed twice per CG step + the Woodbury build pass
         r_pc = min(cfg.cg_precond_rank, m)
-        bytes_ += per_comp * n_iters * (
+        solve_b += per_comp * n_iters * (
             (2 * cfg.cg_iters + 3) * 4 * m * r_pc
         )
+    bytes_ = build_b + solve_b + chol_b + krige_b
     return flops, bytes_, {
         "cg": cg_flops, "chol": chol_flops, "krige": krige_flops,
+        "bytes_phases": {
+            "build": build_b, "solve": solve_b, "chol": chol_b,
+            "krige": krige_b,
+        },
     }
 
 
@@ -359,6 +438,12 @@ def rung_config(env, *, k, n_samples, cov_model, link, n_chains=1,
         # rung (the mixing lever for config3's R-hat 1.453).
         phi_proposals=int(env.get("BENCH_PHI_PROPOSALS", 1)),
         phi_proposal_family=env.get("BENCH_PHI_FAMILY", "gaussian"),
+        # fused Pallas correlation builds (ISSUE 4): BENCH_FUSED_BUILD
+        # =pallas runs any rung with the tiled coords→correlation→
+        # shifted-diagonal kernels replacing the dist-matrix builds
+        # (default off = the historical chain bit-exactly; the
+        # config5_fused_ab probe measures the kernel-level A/B)
+        fused_build=env.get("BENCH_FUSED_BUILD", "off"),
         chol_block_size=int(env.get("BENCH_CHOL_BLOCK", 0)),
         # blocked-GEMM trisolves with carried panel inverses: XLA's
         # native trisolve is latency-bound at these shapes (measured
@@ -459,6 +544,15 @@ def rung_diagnostics(record, res, cfg, *, m, k, q, p_dim, n_samples,
             ),
             "eff_tflops": round(flops / fit_s / 1e12, 2),
             "eff_hbm_gbps": round(bytes_ / fit_s / 1e9, 1),
+            # build-phase share of the analytic HBM traffic, over the
+            # same wall-clock denominator as eff_hbm_gbps — the
+            # first-class fused-build attribution number (drops by
+            # ~the build_bytes_model read ratio when
+            # BENCH_FUSED_BUILD=pallas)
+            "build_hbm_gbps": round(
+                parts["bytes_phases"]["build"] / fit_s / 1e9, 2
+            ),
+            "fused_build": _resolved_fused_build(cfg),
             "cg_rel_residual": round(cg_resid, 6),
         })
         if diagnostics_valid:
@@ -1207,6 +1301,124 @@ def measure_mtm(*, n=512, k=4, q=1, n_iters=24, phi_update_every=2,
     }
 
 
+def measure_fused_build(*, m=3906, j_tries=(1, 4), reps=3,
+                        on_tpu=None):
+    """Fused-vs-baseline A/B at the config5 shape (ISSUE 4): the
+    collapsed/MTM candidate build + batched shifted factor — the
+    (J+1, m, m) masked+shifted correlation stack into the Cholesky —
+    timed back-to-back through the XLA dist-matrix path and the
+    Pallas fused path at m=3906, plus the analytic per-build HBM
+    bytes both ways (ops/pallas_build.build_bytes_model — the
+    O(s*m^2)→O(coord-streams) read reduction).
+
+    Wall-clock cells are measured on TPU only — and only when the
+    one-time Mosaic lowering probe passes (``resolve_fused_build``;
+    a fallen-back backend records the fallback reason, never a raw
+    Pallas compile error). On CPU the fused kernels run in Pallas
+    INTERPRET mode — which jits to a regular XLA program and lands
+    within ~2x of the baseline either way at small m (the r07 probe
+    record: 0.5–1.3x at m=384) — but a CPU wall-clock A/B at this
+    rung's m would compare two XLA-on-CPU codegen paths, saying
+    nothing about the HBM-bandwidth claim the fused build makes (CPU
+    has no HBM; the build is cache/compute-bound there). Those cells
+    carry the analytic bytes and ``measured: false`` with the reason
+    instead. The A/B is per-build GB/s: (read + write bytes) /
+    measured wall.
+    """
+    from smk_tpu.config import SMKConfig
+    from smk_tpu.ops.pallas_build import (
+        DEFAULT_TILE,
+        build_bytes_model,
+        resolve_fused_build,
+    )
+    from smk_tpu.utils.tracing import device_sync
+
+    if on_tpu is None:
+        on_tpu = jax.default_backend() == "tpu"
+    # same gate as the sampler: if Mosaic rejects the kernels on this
+    # TPU the rung records the fallback, not a raw compile error
+    fallback_reason = None
+    if on_tpu and resolve_fused_build("pallas") != "pallas":
+        on_tpu = False
+        fallback_reason = (
+            "TPU backend but resolve_fused_build('pallas') fell back "
+            "to 'off' (one-time Mosaic lowering probe failed) — "
+            "sampler rungs run the XLA path on this chip, so a "
+            "kernel A/B does not exist here"
+        )
+    cfg = SMKConfig(n_subsets=1)
+    jit_eff = cfg.effective_jitter(m)
+    cells = []
+    key = jax.random.key(41)
+    coords = jax.random.uniform(key, (m, 2), jnp.float32)
+    mask = jnp.ones((m,), jnp.float32)
+    shift = jnp.full((m,), jit_eff + 1.0, jnp.float32)
+    xla_build, fused_build = fused_ab_fns(cfg.cov_model, mask, shift)
+
+    for j_try in j_tries:
+        s = j_try + 1
+        phis = jnp.linspace(4.5, 11.0, s).astype(jnp.float32)
+        base_bytes = build_bytes_model(m, s, fused=False)
+        fused_bytes = build_bytes_model(m, s, fused=True)
+        cell = {
+            "J": j_try, "stack": s, "m": m,
+            "bytes_model": {
+                "baseline": base_bytes, "fused": fused_bytes,
+                "read_reduction_x": round(
+                    base_bytes["read_bytes"]
+                    / fused_bytes["read_bytes"], 1
+                ),
+            },
+        }
+        if on_tpu:
+            from smk_tpu.ops.distance import pairwise_distance
+
+            dist = jax.jit(pairwise_distance)(coords)
+            device_sync(dist)
+
+            wall_xla = timed_warm(xla_build, dist, phis, reps=reps)
+            wall_fused = timed_warm(
+                fused_build, coords, phis, reps=reps
+            )
+            moved = (
+                base_bytes["read_bytes"] + base_bytes["write_bytes"]
+            )
+            moved_f = (
+                fused_bytes["read_bytes"]
+                + fused_bytes["write_bytes"]
+            )
+            cell.update({
+                "measured": True,
+                "wall_s_xla": round(wall_xla, 4),
+                "wall_s_fused": round(wall_fused, 4),
+                "speedup_x": round(wall_xla / wall_fused, 3),
+                "build_gbps_xla": round(moved / wall_xla / 1e9, 1),
+                "build_gbps_fused": round(
+                    moved_f / wall_fused / 1e9, 1
+                ),
+            })
+        else:
+            cell.update({
+                "measured": False,
+                "reason": fallback_reason or (
+                    "non-TPU backend: a CPU wall-clock A/B compares "
+                    "two XLA-on-CPU codegen paths (interpret-mode "
+                    "Pallas jits to a regular XLA program) and says "
+                    "nothing about the HBM-bandwidth claim this rung "
+                    "exists to measure — bytes model recorded, see "
+                    "scripts/fused_build_probe.py for the "
+                    "small-m interpret-mode parity/wall record"
+                ),
+            })
+        cells.append(cell)
+    return {
+        "rung": "config5_fused_ab",
+        "m": m, "cov_model": cfg.cov_model,
+        "tile": DEFAULT_TILE,
+        "cells": cells,
+    }
+
+
 def _probe_backend(attempts, wait_s):
     """Initialize-or-fall-back backend probe, run BEFORE the parent
     process touches its own JAX backend (VERDICT r5 #1: a dead TPU
@@ -1467,6 +1679,20 @@ def main():
         except Exception as e:
             reporter.ladder.append(
                 {"rung": "mtm_probe", "error": repr(e)}
+            )
+            reporter.emit(partial=True)
+
+    # Fused-build A/B at the config5 shape (ISSUE 4): Pallas fused
+    # coords→correlation→shifted-factor vs the XLA dist-matrix path,
+    # wall-clock + per-build GB/s (TPU; analytic-bytes-only cells on
+    # CPU). Cheap on TPU (a handful of (J+1, 3906, 3906) builds),
+    # fallible without harming the ladder.
+    if left() > 90 and os.environ.get("BENCH_FUSED_AB", "1") != "0":
+        try:
+            reporter.add_rung(measure_fused_build(on_tpu=on_tpu))
+        except Exception as e:
+            reporter.ladder.append(
+                {"rung": "config5_fused_ab", "error": repr(e)}
             )
             reporter.emit(partial=True)
 

@@ -60,6 +60,7 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
                                 phi.proposal.family = c("gaussian",
                                                         "student_t",
                                                         "mixture"),
+                                fused.build = c("off", "pallas"),
                                 n.report = NULL,
                                 checkpoint.path = NULL,
                                 backend = c("tpu", "cpu"),
@@ -87,12 +88,24 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
   # requires the collapsed sampler (config.overrides = list(
   # phi_sampler = "collapsed")); the default 1/"gaussian" is the
   # classic single-try chain bit-exactly.
+  # fused.build: "pallas" routes every dense correlation build (the
+  # multi-try candidate stacks, the dense-path R rebuild, the kriging
+  # cross/test builds) through tiled Pallas kernels that recompute
+  # distance on the fly from the coordinates — the HBM-bandwidth
+  # lever for large subsets and phi.proposals > 1 on TPU backends
+  # (smk_tpu/ops/pallas_build.py; see the README's fused-build
+  # section). "off" (default) is the historical XLA chain
+  # bit-identically; "pallas" matches it to fp32 tolerance only. On
+  # backend = "cpu" the kernels run in interpret mode —
+  # correctness-preserving, for validation; the HBM-bandwidth win
+  # the kernels exist for is TPU-only.
   # n.report: if set, progress is printed every n.report iterations
   # (the reference's n.report batch printouts, R:84) — the fit then
   # runs through the chunked executor. checkpoint.path: if set, the
   # fit checkpoints each chunk and an interrupted call resumes.
   k.prior <- match.arg(k.prior)
   phi.proposal.family <- match.arg(phi.proposal.family)
+  fused.build <- match.arg(fused.build)
   # link: the reference workflow is logit (spMvGLM binomial fit,
   # 1/(1+exp(-eta)) at MetaKriging_BinaryResponse.R:160); the TPU
   # default is the exact Albert–Chib probit sampler. Users porting the
@@ -140,6 +153,7 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
     link = link,
     phi_proposals = as.integer(phi.proposals),
     phi_proposal_family = phi.proposal.family,
+    fused_build = fused.build,
     priors = smk$PriorConfig(a_prior = k.prior)
   ), config.overrides)
   cfg <- do.call(smk$SMKConfig, cfg_args)

@@ -278,6 +278,28 @@ class SMKConfig:
     cg_precond: str = "jacobi"
     cg_precond_rank: int = 256
 
+    # Fused correlation-build kernels (ops/pallas_build.py): "pallas"
+    # replaces every dense correlation build that today reads a
+    # precomputed (m, m) distance matrix from HBM — the (J+1, m, m)
+    # collapsed/MTM candidate stacks, the dense-path R rebuild, and
+    # the kriging cross/test builds — with tiled Pallas kernels that
+    # recompute distance on the fly from the (m, 2) coordinates and
+    # emit correlation (+ pad-row identity + diagonal shift) tiles
+    # directly into the factor pipeline. Per (s, m, m) stack the HBM
+    # read side drops from s*m^2 floats of distance-matrix traffic to
+    # O(m * s * m / tile) of coordinate streams (~tile/(2 d + 3) ≈
+    # 18x at tile 128, d = 2 — pallas_build.build_bytes_model), the
+    # classic fused-build move for bandwidth-bound batched linalg.
+    # "off" (default) keeps the historical XLA path BIT-identically
+    # (the fused sites are not even traced; tests/test_fused_build.py
+    # pins golden chains). "pallas" matches the XLA build to fp32
+    # tolerance only — chains are statistically equivalent, not
+    # bitwise. On non-TPU backends the kernels run in Pallas interpret
+    # mode (slow; for tests/validation); when Pallas itself is
+    # unavailable the sampler falls back to the XLA path with a
+    # one-time warning (ops/pallas_build.resolve_fused_build).
+    fused_build: str = "off"
+
     # Blocked-GEMM Cholesky for the phi-MH proposal factorization (the
     # one remaining O(m^3) kernel): 0 = XLA's native cholesky; > 0 =
     # ops/chol.py blocked_cholesky with this block size (the same
@@ -422,6 +444,10 @@ class SMKConfig:
         if self.jitter <= 0 or self.jitter_per_m < 0:
             raise ValueError(
                 "jitter must be > 0 and jitter_per_m >= 0"
+            )
+        if self.fused_build not in ("off", "pallas"):
+            raise ValueError(
+                "fused_build must be 'off' or 'pallas'"
             )
         if self.chol_block_size < 0:
             raise ValueError("chol_block_size must be >= 0 (0 = XLA)")

@@ -88,6 +88,13 @@ from smk_tpu.ops.cg import (
 )
 from smk_tpu.ops.distance import cross_distance, pairwise_distance
 from smk_tpu.ops.kernels import correlation, correlation_stack
+from smk_tpu.ops.pallas_build import (
+    fused_correlation_stack,
+    fused_cross_correlation,
+    fused_masked_correlation_stack,
+    fused_masked_shifted_build,
+    resolve_fused_build,
+)
 from smk_tpu.ops.polya_gamma import sample_pg
 from smk_tpu.ops.quantiles import quantile_grid
 from smk_tpu.ops.truncnorm import sample_albert_chib_latent
@@ -132,6 +139,23 @@ class SubsetData(NamedTuple):
     mask: jnp.ndarray
     coords_test: jnp.ndarray
     x_test: jnp.ndarray
+
+
+class BuildConsts(NamedTuple):
+    """Per-subset geometry constants closed over by the scan body —
+    what the correlation builds consume. The XLA path
+    (fused_build="off") precomputes the three distance matrices ONCE
+    (they never change; only the phi decay does) and the coords
+    fields stay None; the fused Pallas path carries the raw
+    coordinates instead (distance is recomputed in-tile from O(m d)
+    coordinate reads — ops/pallas_build.py) and the dist fields stay
+    None, so no (m, m) distance matrix is ever materialized."""
+
+    dist: Optional[jnp.ndarray]  # (m, m) observed pairwise
+    dist_cross: Optional[jnp.ndarray]  # (m, t) observed x test
+    dist_test: Optional[jnp.ndarray]  # (t, t) test pairwise
+    coords: Optional[jnp.ndarray]  # (m, d) — fused path only
+    coords_test: Optional[jnp.ndarray]  # (t, d) — fused path only
 
 
 class SamplerState(NamedTuple):
@@ -187,6 +211,19 @@ def n_params(q: int, p: int) -> int:
     """beta (q*p) + lower-tri of K = A A^T (q(q+1)/2) + phi (q) —
     the spBayes p.beta.theta.samples parameter inventory (R:89)."""
     return q * p + q * (q + 1) // 2 + q
+
+
+def _barrier_present(*vals):
+    """``lax.optimization_barrier`` over the non-None entries of
+    ``vals``, returned in their original positions (None stays None).
+    The presence pattern is STATIC at trace time (fused-path r is
+    None, off-thread_s factors are None), so the shrunken operand
+    tuple is a fixed program per configuration — one call site
+    replaces the hand-maintained per-combination unpack blocks whose
+    memory-sequencing intent is identical."""
+    present = tuple(v for v in vals if v is not None)
+    barred = iter(lax.optimization_barrier(present))
+    return tuple(None if v is None else next(barred) for v in vals)
 
 
 def _pad_identity(r, mask):
@@ -265,6 +302,82 @@ class SpatialGPSampler:
     def __init__(self, config: SMKConfig, *, weight: int = 1):
         self.config = config
         self.weight = int(weight)
+        # Resolved fused-build mode: "pallas" only when the config
+        # asks for it AND Pallas imported (one-time warning + XLA
+        # fallback otherwise). Static — the dispatch below is plain
+        # Python, so fused_build="off" traces the HISTORICAL program
+        # bit-identically (the fused sites do not exist in its jaxpr).
+        self.fused_build = resolve_fused_build(config.fused_build)
+        self._fused = self.fused_build == "pallas"
+
+    # ------------------------------------------------------------------
+    # Correlation builds — the ONE dispatch layer between the sampler
+    # and its (m, m)-build kernels. Every method keeps the historical
+    # XLA expression VERBATIM on the "off" path (golden chains are
+    # bitwise-pinned) and routes to ops/pallas_build.py when fused.
+    # ------------------------------------------------------------------
+    def _masked_corr_stack(self, consts, phis, mask):
+        """(s, m, m) masked correlation stack for an (s,) phi vector
+        (the conditional proposal batch, the CG operator rebuild).
+        Fused: the pad-row identity is applied IN-TILE — no unmasked
+        stack crosses HBM to a second masking pass."""
+        if self._fused:
+            return fused_masked_correlation_stack(
+                consts.coords, phis, mask, self.config.cov_model
+            )
+        return masked_correlation_stack(
+            consts.dist, phis, mask, self.config.cov_model
+        )
+
+    def _masked_corr_one(self, consts, phi, mask):
+        """(m, m) masked correlation at one scalar phi (the dense-path
+        R rebuild and the collapsed accept-side R(phi') build)."""
+        if self._fused:
+            return fused_masked_correlation_stack(
+                consts.coords, jnp.reshape(phi, (1,)), mask,
+                self.config.cov_model,
+            )[0]
+        return masked_correlation(
+            consts.dist, phi, mask, self.config.cov_model
+        )
+
+    def _shifted_chol_stack(self, consts, phis, mask, shift):
+        """(chol_stack, r_stack) for S = R~(phi_k) + diag(shift), the
+        collapsed/MTM candidate build+factor. Fused: the masked,
+        shifted S-stack is emitted directly by the Pallas kernel and
+        factored in place — the unshifted correlation stack is never
+        materialized, so ``r_stack`` is None and accept-side
+        consumers rebuild R(phi') at the one selected phi
+        (_masked_corr_one) instead of slicing the stack."""
+        if self._fused:
+            s_stk = fused_masked_shifted_build(
+                consts.coords, phis, mask, shift,
+                self.config.cov_model,
+            )
+            return jnp.tril(lax.linalg.cholesky(s_stk)), None
+        r_stk = masked_correlation_stack(
+            consts.dist, phis, mask, self.config.cov_model
+        )
+        return batched_shifted_cholesky(r_stk, shift), r_stk
+
+    def _shifted_chol_one(self, consts, phi, mask, shift):
+        """(chol_s, s_mat, r) for ONE scalar phi: the single-try
+        collapsed marginal / dense u-draw S build. Off path: r is the
+        masked correlation and s_mat is None (shifted_cholesky adds
+        the diagonal on the fly, the historical expression). Fused:
+        s_mat is the in-tile shifted build (handed back so the dense
+        u-draw can form R~ s = S s - d s without a second build) and
+        r is None."""
+        if self._fused:
+            s_mat = fused_masked_shifted_build(
+                consts.coords, jnp.reshape(phi, (1,)), mask, shift,
+                self.config.cov_model,
+            )[0]
+            return jnp.tril(lax.linalg.cholesky(s_mat)), s_mat, None
+        r = masked_correlation(
+            consts.dist, phi, mask, self.config.cov_model
+        )
+        return shifted_cholesky(r, shift), None, r
 
     def _chol_r(self, r: jnp.ndarray) -> jnp.ndarray:
         """Factor the (stacked) m x m correlation — through the
@@ -321,19 +434,39 @@ class SpatialGPSampler:
             return blocked_tri_solve(l, b, bs, inv, trans=trans)
         return tri_solve(l, b, trans=trans)
 
-    def _krige_ops(self, chol_r, phi, mask, dist_cross, dist_test, inv):
+    def _cross_test_corr(self, consts, phi, mask):
+        """(r_cross, r_test) for the kriging composition draw: the
+        (q, m, t) masked cross-correlation (pad rows of R_c zeroed so
+        pad latents cannot leak into the test sites) and the
+        (q, t, t) test-site correlation — the ONE fused/off dispatch
+        both the cached (_krige_ops) and uncached prediction paths
+        build from."""
+        cfg = self.config
+        if self._fused:
+            r_cross = mask[None, :, None] * fused_cross_correlation(
+                consts.coords, consts.coords_test, phi, cfg.cov_model
+            )  # (q, m, t)
+            r_test = fused_correlation_stack(
+                consts.coords_test, phi, cfg.cov_model
+            )  # (q, t, t)
+        else:
+            r_cross = mask[None, :, None] * correlation(
+                consts.dist_cross[None], phi[:, None, None],
+                cfg.cov_model,
+            )  # (q, m, t)
+            r_test = correlation(
+                consts.dist_test[None], phi[:, None, None],
+                cfg.cov_model,
+            )  # (q, t, t)
+        return r_cross, r_test
+
+    def _krige_ops(self, chol_r, phi, mask, consts, inv):
         """(krige_w, krige_chol) for the carried factor — the phi-only
         halves of the composition-sampling draw (spPredict, R:85-87):
-        W = R~^{-1} R_c (pad rows of R_c zeroed so pad latents cannot
-        leak into the test sites) and chol(R_t - R_c^T W + jitter).
-        One t-rhs solve pair per call, amortized over phi updates."""
+        W = R~^{-1} R_c and chol(R_t - R_c^T W + jitter). One t-rhs
+        solve pair per call, amortized over phi updates."""
         cfg = self.config
-        r_cross = mask[None, :, None] * correlation(
-            dist_cross[None], phi[:, None, None], cfg.cov_model
-        )  # (q, m, t)
-        r_test = correlation(
-            dist_test[None], phi[:, None, None], cfg.cov_model
-        )  # (q, t, t)
+        r_cross, r_test = self._cross_test_corr(consts, phi, mask)
         jit_eff = cfg.effective_jitter(chol_r.shape[-1])
 
         def one(l_j, rc_j, rt_j, inv_j):
@@ -350,7 +483,7 @@ class SpatialGPSampler:
 
     def _proposal_operators(
         self, r_prop, chol_prop, inv_prop, phi_prop, mask,
-        dist_cross, dist_test, cache,
+        consts, cache,
     ):
         """Proposal-side values for every populated FactorCache field —
         the ONE inventory both phi-MH refresh sites draw from (the
@@ -372,8 +505,7 @@ class SpatialGPSampler:
             r_mv_p, nys_p = self._r_operators(r_prop)
         if cache.krige_w is not None:
             kw_p, kc_p = self._krige_ops(
-                chol_prop, phi_prop, mask, dist_cross, dist_test,
-                inv_prop,
+                chol_prop, phi_prop, mask, consts, inv_prop,
             )
         return FactorCache(
             r_mv=r_mv_p, nys_z=nys_p, chol_inv=inv_prop,
@@ -382,7 +514,7 @@ class SpatialGPSampler:
         )
 
     def _solve_cache(
-        self, dist, mask, state, *, consts=None, predict: bool = False
+        self, consts, mask, state, *, predict: bool = False
     ) -> FactorCache:
         """Cache for the current (phi, chol_r) — the scan-entry (and
         chunk-boundary) build; deterministic in the carried state, so
@@ -393,15 +525,12 @@ class SpatialGPSampler:
         factorizations that scan executed (count_chunk).
 
         ``predict=True`` (collecting scans only) additionally builds
-        the kriging operators from ``consts``' cross/test distances —
+        the kriging operators from ``consts``' cross/test geometry —
         burn-in scans never pay for or carry them."""
         cfg = self.config
         r_mv = nys_z = chol_inv = krige_w = krige_chol = None
         if cfg.u_solver == "cg":
-            r_full = masked_correlation(
-                dist[None], state.phi[:, None, None], mask,
-                cfg.cov_model,
-            )
+            r_full = self._masked_corr_stack(consts, state.phi, mask)
             r_mv, nys_z = self._r_operators(r_full)
         # dense u path: the O(m^2) rebuild is noise next to its
         # O(m^3) per-sweep factorization, so no CG operators — but
@@ -410,8 +539,7 @@ class SpatialGPSampler:
             chol_inv = self._chol_inv(state.chol_r)
         if predict and cfg.krige_cache:
             krige_w, krige_chol = self._krige_ops(
-                state.chol_r, state.phi, mask, consts[1], consts[2],
-                chol_inv,
+                state.chol_r, state.phi, mask, consts, chol_inv,
             )
         return FactorCache(
             r_mv=r_mv, nys_z=nys_z, chol_inv=chol_inv,
@@ -438,10 +566,16 @@ class SpatialGPSampler:
         phi0 = jnp.full((q,), 3.0 / 0.5, dtype)
         lo, hi = self.config.priors.phi_min, self.config.priors.phi_max
         phi0 = jnp.clip(phi0, lo + 1e-3 * (hi - lo), hi - 1e-3 * (hi - lo))
-        dist = pairwise_distance(data.coords)
-        r0 = masked_correlation(
-            dist[None], phi0[:, None, None], data.mask, self.config.cov_model
-        )
+        if self._fused:
+            r0 = fused_masked_correlation_stack(
+                data.coords, phi0, data.mask, self.config.cov_model
+            )
+        else:
+            dist = pairwise_distance(data.coords)
+            r0 = masked_correlation(
+                dist[None], phi0[:, None, None], data.mask,
+                self.config.cov_model,
+            )
         return SamplerState(
             beta=beta_init.astype(dtype),
             u=jnp.zeros((m, q), dtype),
@@ -464,7 +598,7 @@ class SpatialGPSampler:
         weight = self.weight
         m, q, p = data.x.shape
         dtype = data.x.dtype
-        dist, dist_cross, dist_test = consts
+        dist = consts.dist  # None on the fused path (see BuildConsts)
         mask = data.mask
 
         key, kz, kb, kphi, kprop, ku_prior, ku_noise, ka, kpred = jax.random.split(
@@ -568,9 +702,8 @@ class SpatialGPSampler:
 
             chol_cur = state.chol_r  # factored when phi last changed
             with jax.named_scope("phi_chol"):
-                r_prop = masked_correlation(
-                    dist[None], phi_prop[:, None, None], mask,
-                    cfg.cov_model,
+                r_prop = self._masked_corr_stack(
+                    consts, phi_prop, mask
                 )
                 chol_prop = self._chol_r(r_prop)
             cache2 = tick(cache, q, n_calls=1)  # ONE batched
@@ -606,7 +739,7 @@ class SpatialGPSampler:
                 def refresh(c):
                     prop_ops = self._proposal_operators(
                         r_prop, chol_prop, inv_prop, phi_prop, mask,
-                        dist_cross, dist_test, c,
+                        consts, c,
                     )
                     return select_accept(prop_ops, c, accept)
 
@@ -711,12 +844,14 @@ class SpatialGPSampler:
                     # (identity correlation rows, ytilde = 0, d = big)
                     # contribute a phi-free constant that cancels in
                     # the ratio, so padding cannot bias phi here
-                    # either
+                    # either. On the fused path S arrives shifted
+                    # straight from the Pallas tile (r is then None —
+                    # the accept side rebuilds R at the one selected
+                    # phi instead of keeping the stack live).
                     with jax.named_scope("phi_marg_chol"):
-                        r = masked_correlation(
-                            dist, phi_v, mask, cfg.cov_model
+                        chol_s, _, r = self._shifted_chol_one(
+                            consts, phi_v, mask, shift
                         )
-                        chol_s = shifted_cholesky(r, shift)
                     alpha = self._tri(chol_s, ytilde)
                     ll = -0.5 * jnp.sum(alpha * alpha) - 0.5 * (
                         chol_logdet(chol_s)
@@ -750,29 +885,21 @@ class SpatialGPSampler:
                     # cg/bench scale.)
                     cache = tick(cache, 2)  # S_cur and S_prop
                     ll_cur, _, chol_s_cur = marg_ll(phi_j)
-                    if thread_s:
-                        ll_cur, chol_s_cur, phi_prop = (
-                            lax.optimization_barrier(
-                                (ll_cur, chol_s_cur, phi_prop)
-                            )
-                        )
-                    else:
+                    if not thread_s:
                         chol_s_cur = None
-                        ll_cur, phi_prop = lax.optimization_barrier(
-                            (ll_cur, phi_prop)
-                        )
+                    ll_cur, chol_s_cur, phi_prop = _barrier_present(
+                        ll_cur, chol_s_cur, phi_prop
+                    )
                     ll_prop, r_prop, chol_s_prop = marg_ll(phi_prop)
-                    if thread_s:
-                        ll_prop, r_prop, chol_s_prop = (
-                            lax.optimization_barrier(
-                                (ll_prop, r_prop, chol_s_prop)
-                            )
-                        )
-                    else:
+                    # r_prop is statically None on the fused path and
+                    # chol_s_prop off the thread_s path — the barrier
+                    # operand tuple shrinks accordingly (None is not
+                    # a barrier operand)
+                    if not thread_s:
                         chol_s_prop = None
-                        ll_prop, r_prop = lax.optimization_barrier(
-                            (ll_prop, r_prop)
-                        )
+                    ll_prop, r_prop, chol_s_prop = _barrier_present(
+                        ll_prop, r_prop, chol_s_prop
+                    )
                     log_ratio = (
                         ll_prop
                         + jnp.log(sig_prop * (1.0 - sig_prop))
@@ -818,11 +945,8 @@ class SpatialGPSampler:
                         # weight sums — the MTM form of the
                         # finite-factor guard.
                         with mtm_chol_scope():
-                            r_stk = masked_correlation_stack(
-                                dist, phi_vec, mask, cfg.cov_model
-                            )
-                            chol_stk = batched_shifted_cholesky(
-                                r_stk, shift
+                            chol_stk, r_stk = self._shifted_chol_stack(
+                                consts, phi_vec, mask, shift
                             )
                         yt = jnp.broadcast_to(
                             ytilde,
@@ -857,7 +981,13 @@ class SpatialGPSampler:
                     k_idx = jax.random.categorical(k_sel, lw_fwd)
                     phi_prop = phi_stack[k_idx + 1]
                     t_sel = t_stack[k_idx + 1]
-                    r_prop = r_stack[k_idx + 1]
+                    # r_stack is statically None on the fused path
+                    # (the accept side rebuilds R(phi') at the one
+                    # selected phi — _masked_corr_one — instead of
+                    # keeping the unshifted stack live)
+                    r_prop = (
+                        None if r_stack is None else r_stack[k_idx + 1]
+                    )
                     # barrier: only the selected slices survive —
                     # the (J+1) m^2 forward workspaces must die
                     # before the reference batch allocates (the same
@@ -865,21 +995,15 @@ class SpatialGPSampler:
                     if thread_s:
                         chol_s_cur = chol_stack[0]
                         chol_s_prop = chol_stack[k_idx + 1]
-                        (
-                            lw_fwd, lw_cur, phi_prop, t_sel, r_prop,
-                            chol_s_cur, chol_s_prop,
-                        ) = lax.optimization_barrier((
-                            lw_fwd, lw_cur, phi_prop, t_sel, r_prop,
-                            chol_s_cur, chol_s_prop,
-                        ))
                     else:
                         chol_s_cur = chol_s_prop = None
-                        (lw_fwd, lw_cur, phi_prop, t_sel, r_prop) = (
-                            lax.optimization_barrier((
-                                lw_fwd, lw_cur, phi_prop, t_sel,
-                                r_prop,
-                            ))
-                        )
+                    (
+                        lw_fwd, lw_cur, phi_prop, t_sel, r_prop,
+                        chol_s_cur, chol_s_prop,
+                    ) = _barrier_present(
+                        lw_fwd, lw_cur, phi_prop, t_sel, r_prop,
+                        chol_s_cur, chol_s_prop,
+                    )
                     # reference set: J-1 fresh draws from the same
                     # kernel centered at the SELECTED candidate; the
                     # current point is the J-th reference point and
@@ -917,9 +1041,18 @@ class SpatialGPSampler:
                     # SMKConfig.phi_sampler) — plus the solve-operator
                     # refresh (same field inventory as the conditional
                     # step's, via _proposal_operators with a 1-length
-                    # component axis).
+                    # component axis). Fused path: R(phi') was never
+                    # materialized by the marginal build (only the
+                    # shifted S was), so it is rebuilt here at the
+                    # one selected phi — one O(m^2) tile pass, taken
+                    # only on the accept side.
+                    r_acc = (
+                        self._masked_corr_one(consts, phi_prop, mask)
+                        if r_prop is None
+                        else r_prop
+                    )
                     with jax.named_scope("phi_chol"):
-                        chol_prop = self._chol_r(r_prop)
+                        chol_prop = self._chol_r(r_acc)
                     cache = tick(cache, 1)
                     # fp32 guard: the marginal ratio factors the WELL-
                     # conditioned S = R + jit I + D, so it can accept
@@ -941,12 +1074,11 @@ class SpatialGPSampler:
                             else None
                         )
                         prop_ops = self._proposal_operators(
-                            r_prop[None], chol_prop[None],
+                            r_acc[None], chol_prop[None],
                             None
                             if inv_prop_j is None
                             else inv_prop_j[None],
-                            phi_prop[None], mask, dist_cross,
-                            dist_test, cache,
+                            phi_prop[None], mask, consts, cache,
                         )
                     return chol_prop, prop_ops, ok, cache
 
@@ -1022,10 +1154,9 @@ class SpatialGPSampler:
                     # the schedule cond) so the draw itself never
                     # factorizes; same per-sweep count as the legacy
                     # dense path, one site instead of two
-                    r0 = masked_correlation(
-                        dist, phi[j], mask, cfg.cov_model
+                    chol_s, _, _ = self._shifted_chol_one(
+                        consts, phi[j], mask, shift
                     )
-                    chol_s = shifted_cholesky(r0, shift)
                     cache = tick(cache, 1)
                 return phi, chol_r, cache, jnp.zeros((), dtype), chol_s
 
@@ -1121,14 +1252,37 @@ class SpatialGPSampler:
                 # draw performs NO factorization of its own; the
                 # conditional sampler and the factor_reuse=False
                 # baseline still factor here.
-                r0 = masked_correlation(
-                    dist, phi[j], mask, cfg.cov_model
-                )
-                if chol_s is None:
-                    chol_s = shifted_cholesky(r0, jit_eff + d_vec)
+                if self._fused and chol_s is None:
+                    # one fused shifted build serves BOTH the factor
+                    # and the Matheron back-multiply:
+                    # R~ s + jit s = (S - diag(d)) s (fp reassociation
+                    # only — the fused path is tolerance-level, not
+                    # bitwise)
+                    chol_s, s_mat, _ = self._shifted_chol_one(
+                        consts, phi[j], mask, jit_eff + d_vec
+                    )
                     cache = tick(cache, 1)
-                s = chol_solve(chol_s, rhs_vec)
-                u = u.at[:, j].set(u_star + r0 @ s + jit_eff * s)
+                    s = chol_solve(chol_s, rhs_vec)
+                    u = u.at[:, j].set(
+                        u_star + s_mat @ s - d_vec * s
+                    )
+                elif self._fused:
+                    # thread_s handed the factor over; only the
+                    # unshifted R~ matvec is rebuilt
+                    r0 = self._masked_corr_one(consts, phi[j], mask)
+                    s = chol_solve(chol_s, rhs_vec)
+                    u = u.at[:, j].set(
+                        u_star + r0 @ s + jit_eff * s
+                    )
+                else:
+                    r0 = masked_correlation(
+                        dist, phi[j], mask, cfg.cov_model
+                    )
+                    if chol_s is None:
+                        chol_s = shifted_cholesky(r0, jit_eff + d_vec)
+                        cache = tick(cache, 1)
+                    s = chol_solve(chol_s, rhs_vec)
+                    u = u.at[:, j].set(u_star + r0 @ s + jit_eff * s)
             return (phi, chol_r, cache, u, accepted), None
 
         (phi, chol_r, cache, u, accepted), _ = lax.scan(
@@ -1246,12 +1400,7 @@ class SpatialGPSampler:
                     "qts,qs->qt", cache.krige_chol, z
                 )
         else:
-            r_cross = mask[None, :, None] * correlation(
-                dist_cross[None], phi[:, None, None], cfg.cov_model
-            )  # (q, m, t)
-            r_test = correlation(
-                dist_test[None], phi[:, None, None], cfg.cov_model
-            )  # (q, t, t)
+            r_cross, r_test = self._cross_test_corr(consts, phi, mask)
 
             @jax.named_scope("krige")
             def krige(l_j, rc_j, rt_j, u_j, key_j, inv_j):
@@ -1348,13 +1497,22 @@ class SpatialGPSampler:
     # -- resumable pieces (used by run() and the checkpointed executor,
     # parallel/resume.py; chunking the sampling scan changes nothing:
     # the PRNG sequence lives in the carried state) -------------------
-    def _consts(self, data):
+    def _consts(self, data) -> BuildConsts:
         # Per-subset constants, built once and closed over by the scan
-        # body (distances never change; only the phi decay does).
-        return (
+        # body (distances never change; only the phi decay does). The
+        # fused path carries the raw coordinates INSTEAD of the
+        # precomputed distance matrices — the Pallas kernels
+        # recompute distance in-tile, so the (m, m) dist never exists.
+        if self._fused:
+            return BuildConsts(
+                None, None, None, data.coords, data.coords_test
+            )
+        return BuildConsts(
             pairwise_distance(data.coords),
             cross_distance(data.coords, data.coords_test),
             pairwise_distance(data.coords_test),
+            None,
+            None,
         )
 
     def burn_in(self, data: SubsetData, init_state: SamplerState):
@@ -1365,7 +1523,7 @@ class SpatialGPSampler:
 
     def _burn_in(self, data, init_state):
         consts = self._consts(data)
-        cache = self._solve_cache(consts[0], data.mask, init_state)
+        cache = self._solve_cache(consts, data.mask, init_state)
         step = lambda st, it: (
             self._gibbs_step(data, consts, st, it, collect=False)[0],
             None,
@@ -1391,7 +1549,7 @@ class SpatialGPSampler:
         rates are post-burn-in."""
         with jax.default_matmul_precision(self.config.matmul_precision):
             consts = self._consts(data)
-            cache = self._solve_cache(consts[0], data.mask, state)
+            cache = self._solve_cache(consts, data.mask, state)
             step = lambda st, it: (
                 self._gibbs_step(data, consts, st, it, collect=False)[0],
                 None,
@@ -1434,8 +1592,7 @@ class SpatialGPSampler:
         with jax.default_matmul_precision(cfg.matmul_precision):
             consts = self._consts(data)
             cache = self._solve_cache(
-                consts[0], data.mask, state, consts=consts,
-                predict=collect,
+                consts, data.mask, state, predict=collect
             )
             step = lambda carry, it: (
                 self._gibbs_step(data, consts, carry, it,
@@ -1467,7 +1624,7 @@ class SpatialGPSampler:
     def _sample_chunk(self, data, state, start_it, n_iters):
         consts = self._consts(data)
         cache = self._solve_cache(
-            consts[0], data.mask, state, consts=consts, predict=True
+            consts, data.mask, state, predict=True
         )
         step = lambda st, it: self._gibbs_step(
             data, consts, st, it, collect=True

@@ -3,6 +3,14 @@ backend (reference L1 layer — SURVEY.md §1, §2.3)."""
 
 from smk_tpu.ops.distance import pairwise_distance, cross_distance
 from smk_tpu.ops.kernels import correlation, CORRELATION_FNS
+from smk_tpu.ops.pallas_build import (
+    fused_correlation,
+    fused_correlation_stack,
+    fused_cross_correlation,
+    fused_masked_correlation_stack,
+    fused_masked_shifted_build,
+    pallas_available,
+)
 from smk_tpu.ops.chol import (
     jittered_cholesky,
     chol_solve,
@@ -23,6 +31,12 @@ __all__ = [
     "cross_distance",
     "correlation",
     "CORRELATION_FNS",
+    "fused_correlation",
+    "fused_correlation_stack",
+    "fused_cross_correlation",
+    "fused_masked_correlation_stack",
+    "fused_masked_shifted_build",
+    "pallas_available",
     "jittered_cholesky",
     "chol_solve",
     "chol_logdet",

@@ -7,6 +7,13 @@ elementwise ops so XLA maps the O(m^2 d) work onto the MXU, and the
 matrices can be built once per subset and reused across all MCMC
 iterations (only the correlation decay changes with phi, not the
 distances).
+
+The norm-trick expansion here is the GEMM-shaped XLA build; its
+fp32-tolerance parity against the naive per-pair form and the
+exact-zero-diagonal guarantee are pinned in tests/test_distance.py.
+The fused Pallas path (ops/pallas_build.py, SMKConfig.fused_build)
+never calls these — it recomputes the per-pair differences in-tile
+from the raw coordinates, so no distance matrix exists at all.
 """
 
 from __future__ import annotations

@@ -1,0 +1,474 @@
+"""Pallas fused correlation-build kernels (SMKConfig.fused_build).
+
+The Gibbs hot loop's covariance builds today read a precomputed
+(m, m) distance matrix from HBM once per candidate — an (s, m, m)
+correlation stack costs s*m^2 floats of distance traffic before the
+batched Cholesky reads the result AGAIN from HBM. These kernels tile
+the output, recompute the pairwise distances on the fly from the
+(m, d) coordinates inside each (tile, tile) block, and emit the
+correlation — optionally with the pad-row identity treatment and the
+diagonal shift already applied — so the factor pipeline's input is
+produced in one pass whose HBM read side is coordinate streams, not
+matrix streams (``build_bytes_model`` quantifies the reduction:
+~tile/(2 d + 3) ≈ 18x at tile 128, d = 2, mask/shift streams
+counted).
+
+Three public kernels (mirroring the XLA build sites in
+models/probit_gp.py):
+
+- :func:`fused_correlation`          — (m, m) from (m, d) coords, one
+  phi; exact-unit diagonal (the in-tile diagonal distance is forced
+  to exact zero, as ops/distance.pairwise_distance does).
+- :func:`fused_correlation_stack`    — (s, m, m) for an (s,) phi
+  vector: the multi-try candidate build; the coordinates stream once
+  per output tile whatever s is.
+- :func:`fused_masked_shifted_build` — the collapsed-marginal S-build:
+  M R M + (I - M) + diag(shift) per stack element, ready for a plain
+  ``lax.linalg.cholesky`` with NO intermediate (s, m, m) HBM round
+  trip between build and factor input.
+
+Plus :func:`fused_cross_correlation` for the kriging cross builds
+((s, ma, mb) between two coordinate sets — no diagonal treatment).
+
+Numerics: the in-tile distance is the direct per-pair squared
+difference (d is tiny and static, so this is a handful of VPU ops per
+tile and avoids the norm-trick's cancellation); correlation math is
+shared with ops/kernels.py (same CORRELATION_FNS). Parity with the
+XLA build is fp32-tolerance, not bitwise — the "off" config path
+never routes through this module.
+
+Backends: on TPU the kernels compile through Mosaic; on every other
+backend they run in Pallas interpret mode (jitted through XLA like
+any other program, but with none of the HBM-traffic properties the
+kernels exist for — tests/validation only). When Pallas itself cannot
+be imported, or the one-time TPU lowering probe fails,
+:func:`resolve_fused_build` falls back to "off" with a one-time
+warning. Every kernel invocation is wrapped in
+utils/tracing.FUSED_BUILD_SCOPE for profile attribution.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from smk_tpu.ops.kernels import CORRELATION_FNS
+from smk_tpu.utils.tracing import fused_build_scope
+
+try:  # pragma: no cover - import availability is environment-defined
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS_IMPORT_ERROR: Optional[BaseException] = None
+except Exception as _e:  # pragma: no cover
+    pl = None  # type: ignore[assignment]
+    pltpu = None  # type: ignore[assignment]
+    _PALLAS_IMPORT_ERROR = _e
+
+# Output tile edge: 128 matches the MXU/VPU lane width; non-multiple
+# shapes run as ragged boundary blocks (ceil-div grid, OOB writes
+# dropped), so no caller-visible padding exists at any m.
+DEFAULT_TILE = 128
+
+
+def pallas_available() -> bool:
+    """Whether the Pallas machinery imported in this environment."""
+    return pl is not None
+
+
+_FALLBACK_WARNED = False
+
+
+_TPU_LOWER_ERROR: Optional[BaseException] = None
+_TPU_LOWER_PROBED = False
+
+
+def _tpu_lowering_error() -> Optional[BaseException]:
+    """ONE-time probe that Mosaic actually compiles the fused kernel
+    family on this TPU. ``pallas_available()`` only proves the import;
+    the kernels' block shapes ((tile, 2) coord panels, (tile, 1)
+    mask/shift columns, SMEM phi scalars) are exactly what Mosaic's
+    layout rules are pickiest about, so without this probe a rejected
+    lowering would abort the whole fit at first compile instead of
+    falling back. Probes every distinct layout the family emits: the
+    richest square kernel (masked + shifted) at a RAGGED m — one
+    compile covers both the aligned interior blocks and the
+    boundary-block path the flagship m=3906 hits — plus the
+    two-operand cross kernel at mismatched ragged sizes. Returns the
+    exception on failure, None when all compiles succeed."""
+    global _TPU_LOWER_ERROR, _TPU_LOWER_PROBED
+    if not _TPU_LOWER_PROBED:
+        _TPU_LOWER_PROBED = True
+        try:
+            m = DEFAULT_TILE + 19  # ragged: interior + boundary blocks
+            out = fused_masked_shifted_build(
+                jnp.zeros((m, 2), jnp.float32),
+                jnp.ones((1,), jnp.float32),
+                jnp.ones((m,), jnp.float32),
+                jnp.full((m,), 0.5, jnp.float32),
+                "exponential",
+                interpret=False,
+            )
+            cross = fused_cross_correlation(
+                jnp.zeros((m, 2), jnp.float32),
+                jnp.zeros((DEFAULT_TILE - 5, 2), jnp.float32),
+                jnp.ones((2,), jnp.float32),
+                "exponential",
+                interpret=False,
+            )
+            jax.block_until_ready((out, cross))
+        except Exception as exc:
+            _TPU_LOWER_ERROR = exc
+    return _TPU_LOWER_ERROR
+
+
+def resolve_fused_build(mode: str) -> str:
+    """Map a config ``fused_build`` value to the mode actually usable
+    here: "pallas" stays "pallas" when Pallas imported (interpret mode
+    covers non-TPU backends) AND — on a real TPU — a one-time probe
+    compile of the kernel family succeeds; otherwise falls back to
+    "off" with a ONE-time warning (the sampler then runs the
+    historical XLA path unchanged). "off" passes through untouched."""
+    if mode != "pallas":
+        return "off"
+    global _FALLBACK_WARNED
+    if pallas_available():
+        if _interpret_default():
+            return "pallas"  # interpret mode: Mosaic never runs
+        err = _tpu_lowering_error()
+        if err is None:
+            return "pallas"
+        if not _FALLBACK_WARNED:
+            warnings.warn(
+                "SMKConfig.fused_build='pallas' requested but the "
+                "Pallas kernels failed to compile on this TPU "
+                f"({err!r}) — falling back to the XLA "
+                "correlation-build path (fused_build='off' behavior).",
+                UserWarning,
+                stacklevel=2,
+            )
+            _FALLBACK_WARNED = True
+        return "off"
+    if not _FALLBACK_WARNED:
+        warnings.warn(
+            "SMKConfig.fused_build='pallas' requested but "
+            "jax.experimental.pallas is unavailable in this "
+            f"environment ({_PALLAS_IMPORT_ERROR!r}) — falling back "
+            "to the XLA correlation-build path (fused_build='off' "
+            "behavior).",
+            UserWarning,
+            stacklevel=2,
+        )
+        _FALLBACK_WARNED = True
+    return "off"
+
+
+def _interpret_default() -> bool:
+    """Interpret mode unless the default backend is a real TPU —
+    Mosaic only compiles there; interpret mode is the everywhere-else
+    (CPU CI above all) execution path."""
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:  # pragma: no cover - backend init failure
+        return True
+
+
+def _corr_kernel(model: str, tile: int, *, masked: bool, shifted: bool,
+                 zero_diag: bool):
+    """Kernel body factory. Ref order (grid = (s, ni, nj)):
+    phi (SMEM scalar), coords_a block, coords_b block,
+    [mask_a, mask_b,] [shift,] out block."""
+    corr_fn = CORRELATION_FNS[model]
+
+    def kernel(phi_ref, ca_ref, cb_ref, *refs):
+        idx = 0
+        if masked:
+            ma_ref, mb_ref = refs[idx], refs[idx + 1]
+            idx += 2
+        if shifted:
+            sh_ref = refs[idx]
+            idx += 1
+        out_ref = refs[idx]
+
+        i = pl.program_id(1)
+        j = pl.program_id(2)
+        a = ca_ref[...]  # (tile, d)
+        b = cb_ref[...]  # (tile, d)
+        d = a.shape[1]
+        # direct per-pair squared differences: d is tiny/static, so
+        # this is a few VPU ops per tile and — unlike the norm trick —
+        # cancellation-free (coincident points give exact zero)
+        sq = jnp.zeros((tile, tile), a.dtype)
+        for k in range(d):
+            diff = a[:, k : k + 1] - b[:, k : k + 1].T
+            sq = sq + diff * diff
+        need_eye = masked or shifted or zero_diag
+        if need_eye:
+            rows = i * tile + jax.lax.broadcasted_iota(
+                jnp.int32, (tile, tile), 0
+            )
+            cols = j * tile + jax.lax.broadcasted_iota(
+                jnp.int32, (tile, tile), 1
+            )
+            eye_b = rows == cols
+        dist = jnp.sqrt(jnp.maximum(sq, 0.0))
+        if zero_diag:
+            # exact-zero diagonal, as pairwise_distance forces — the
+            # correlation diagonal is then exactly 1 for every model
+            dist = jnp.where(eye_b, jnp.zeros_like(dist), dist)
+        rho = corr_fn(dist, phi_ref[0, 0])
+        if masked:
+            # R~ = M R M + (I - M): pad rows become standard-basis
+            # vectors (the probit_gp._pad_identity treatment, in-tile)
+            mm = ma_ref[...] * mb_ref[...].T  # (tile, 1) x (1, tile)
+            rho = mm * rho + (1.0 - mm) * eye_b.astype(rho.dtype)
+        if shifted:
+            rho = rho + jnp.where(
+                eye_b, sh_ref[...], jnp.zeros_like(rho)
+            )
+        out_ref[0] = rho
+
+    return kernel
+
+
+def _fused_build(
+    coords_a: jnp.ndarray,
+    coords_b: jnp.ndarray,
+    phis: jnp.ndarray,
+    model: str,
+    *,
+    mask: Optional[jnp.ndarray] = None,
+    shift: Optional[jnp.ndarray] = None,
+    zero_diag: bool = False,
+    tile: int = DEFAULT_TILE,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Shared driver: (s, ma, mb) correlation stack, tiled (s, ni, nj).
+
+    coords_a: (ma, d); coords_b: (mb, d); phis: (s,). ``mask``/
+    ``shift`` are (ma,) vectors (square same-coords builds only) —
+    mask applies the pad-row identity, shift adds to the diagonal.
+    Non-tile-multiple shapes use Pallas's ragged boundary blocks
+    directly (ceil-div grid): boundary-lane input reads may carry
+    pad garbage, but every op here is elementwise within the block —
+    garbage stays in its lane — and out-of-bounds output lanes are
+    dropped on write, so no edge-padded (s, mp, mp) intermediate or
+    slice-back copy ever exists (``build_bytes_model`` counts the
+    write side at exactly s*m^2 on that basis).
+    """
+    if pl is None:  # pragma: no cover - callers gate on availability
+        raise RuntimeError(
+            "Pallas unavailable; gate calls on pallas_available()"
+        ) from _PALLAS_IMPORT_ERROR
+    if model not in CORRELATION_FNS:
+        raise ValueError(
+            f"unknown cov model {model!r}; expected one of "
+            f"{sorted(CORRELATION_FNS)}"
+        )
+    masked = mask is not None
+    shifted = shift is not None
+    if (masked or shifted) and coords_a is not coords_b:
+        # no same-shape escape hatch: the in-tile row==col test is the
+        # "same point" diagonal ONLY when both operands are literally
+        # the same coordinate set, and mask is applied to rows AND
+        # columns — a same-shape cross build would silently compute
+        # garbage rather than fail
+        raise ValueError(
+            "mask/shift require a square same-coordinates build "
+            "(pass the identical coords array for both operands)"
+        )
+    if interpret is None:
+        interpret = _interpret_default()
+    dtype = coords_a.dtype
+    ma, d = coords_a.shape
+    mb = coords_b.shape[0]
+    s = phis.shape[0]
+    phis2 = phis.astype(dtype).reshape(s, 1)
+
+    in_specs = [
+        pl.BlockSpec(
+            (1, 1), lambda k, i, j: (k, 0), memory_space=pltpu.SMEM
+        ),
+        pl.BlockSpec((tile, d), lambda k, i, j: (i, 0)),
+        pl.BlockSpec((tile, d), lambda k, i, j: (j, 0)),
+    ]
+    args = [phis2, coords_a, coords_b]
+    if masked:
+        mk = mask.astype(dtype).reshape(ma, 1)
+        in_specs += [
+            pl.BlockSpec((tile, 1), lambda k, i, j: (i, 0)),
+            pl.BlockSpec((tile, 1), lambda k, i, j: (j, 0)),
+        ]
+        args += [mk, mk]
+    if shifted:
+        sh = jnp.zeros((ma,), dtype) + shift  # broadcast scalar/(m,)
+        in_specs.append(
+            pl.BlockSpec((tile, 1), lambda k, i, j: (i, 0))
+        )
+        args.append(sh.reshape(ma, 1))
+
+    kernel = _corr_kernel(
+        model, tile, masked=masked, shifted=shifted,
+        zero_diag=zero_diag,
+    )
+    with fused_build_scope():
+        return pl.pallas_call(
+            kernel,
+            grid=(s, -(-ma // tile), -(-mb // tile)),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (1, tile, tile), lambda k, i, j: (k, i, j)
+            ),
+            out_shape=jax.ShapeDtypeStruct((s, ma, mb), dtype),
+            interpret=interpret,
+        )(*args)
+
+
+def fused_correlation(
+    coords: jnp.ndarray,
+    phi: jnp.ndarray,
+    model: str,
+    *,
+    tile: int = DEFAULT_TILE,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """(m, m) correlation from (m, d) coords and a scalar phi — the
+    fused equivalent of ``correlation(pairwise_distance(coords), phi,
+    model)`` (exact-unit diagonal, symmetric by construction: the
+    per-pair tile math is index-symmetric)."""
+    phis = jnp.reshape(jnp.asarray(phi, coords.dtype), (1,))
+    return _fused_build(
+        coords, coords, phis, model, zero_diag=True, tile=tile,
+        interpret=interpret,
+    )[0]
+
+
+def fused_correlation_stack(
+    coords: jnp.ndarray,
+    phis: jnp.ndarray,
+    model: str,
+    *,
+    tile: int = DEFAULT_TILE,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """(s, m, m) correlation stack for an (s,) phi vector — the
+    multi-try candidate build: coordinates stream once per output
+    tile; no (m, m) distance matrix is ever materialized."""
+    return _fused_build(
+        coords, coords, phis, model, zero_diag=True, tile=tile,
+        interpret=interpret,
+    )
+
+
+def fused_masked_correlation_stack(
+    coords: jnp.ndarray,
+    phis: jnp.ndarray,
+    mask: jnp.ndarray,
+    model: str,
+    *,
+    tile: int = DEFAULT_TILE,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """(s, m, m) stack of R~ = M R(phi_k) M + (I - M) — the masked
+    correlation build (models/probit_gp._pad_identity) with the
+    pad-row identity applied IN-TILE: the CG operator rebuild, the
+    conditional proposal stack, and the accept-side R(phi') rebuild
+    never stream an unmasked stack back through a second XLA
+    masking pass. coords: (m, d); phis: (s,); mask: (m,) of 0/1."""
+    return _fused_build(
+        coords, coords, phis, model, mask=mask, zero_diag=True,
+        tile=tile, interpret=interpret,
+    )
+
+
+def fused_cross_correlation(
+    coords_a: jnp.ndarray,
+    coords_b: jnp.ndarray,
+    phis: jnp.ndarray,
+    model: str,
+    *,
+    tile: int = DEFAULT_TILE,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """(s, ma, mb) cross-correlation stack between two coordinate
+    sets — the kriging cross-build (no diagonal treatment; apply row
+    masking outside, as the XLA path does)."""
+    return _fused_build(
+        coords_a, coords_b, phis, model, tile=tile,
+        interpret=interpret,
+    )
+
+
+def fused_masked_shifted_build(
+    coords: jnp.ndarray,
+    phis: jnp.ndarray,
+    mask: jnp.ndarray,
+    shift: jnp.ndarray,
+    model: str,
+    *,
+    tile: int = DEFAULT_TILE,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """(s, m, m) stack of S = M R(phi_k) M + (I - M) + diag(shift) —
+    the collapsed-phi marginal build with the pad-row identity and
+    the diagonal shift applied IN-TILE, so the output feeds
+    ``lax.linalg.cholesky`` (or the blocked Cholesky's first panel)
+    directly: no intermediate correlation stack crosses HBM between
+    build and shift.
+
+    coords: (m, d); phis: (s,); mask: (m,); shift: scalar or (m,)
+    positive diagonal (shared across the stack — D is phi-free).
+    Matches ``masked_correlation_stack(dist, phis, mask, model)
+    + diag(shift)`` to fp32 tolerance.
+    """
+    return _fused_build(
+        coords, coords, phis, model, mask=mask, shift=shift,
+        zero_diag=True, tile=tile, interpret=interpret,
+    )
+
+
+def build_bytes_model(
+    m: int,
+    s: int = 1,
+    *,
+    d: int = 2,
+    tile: int = DEFAULT_TILE,
+    fused: bool,
+    dtype_bytes: int = 4,
+) -> dict:
+    """Analytic HBM traffic of one (s, m, m) correlation-stack build.
+
+    Baseline (XLA from a precomputed distance matrix): the elementwise
+    stack build streams the (m, m) distance matrix once per stack
+    element — s*m^2 reads — and writes s*m^2 outputs.
+
+    Fused: each (tile, tile) output tile reads two (tile, d)
+    coordinate blocks (plus mask/shift rows, counted at one extra
+    column each); over s * ceil(m/tile)^2 tiles the read side is
+    O(s * m^2 * d / tile) — a tile/(2 d + 3) ≈ 18x reduction at the
+    defaults. Writes are IDENTICAL — exactly s*m^2 either way: the
+    kernel emits the (s, m, m) output directly via ragged boundary
+    blocks (no edge-padded intermediate, no slice-back copy — see
+    _fused_build), so the write side is the floor both paths share
+    and the reduction claim is about the term the fusion changes.
+    """
+    nt = -(-m // tile)
+    write = s * m * m * dtype_bytes
+    if not fused:
+        return {
+            "read_bytes": s * m * m * dtype_bytes,
+            "write_bytes": write,
+            "total_bytes": s * m * m * dtype_bytes + write,
+        }
+    # coords (2 blocks of (tile, d)) + ~3 (tile, 1) mask/shift rows;
+    # boundary blocks stream full tiles, hence the ceil-div count
+    per_tile = (2 * tile * d + 3 * tile) * dtype_bytes
+    read = s * nt * nt * per_tile
+    return {
+        "read_bytes": read,
+        "write_bytes": write,
+        "total_bytes": read + write,
+    }

@@ -37,6 +37,7 @@ from smk_tpu.models.probit_gp import (
 )
 from smk_tpu.parallel.executor import (
     DATA_AXES,
+    write_draws,
     init_subset_states,
     stacked_subset_data,
     subset_chain_keys,
@@ -325,12 +326,36 @@ def fit_subsets_chunked(
     d_w = coords_test.shape[0] * q
     dtype = part.x.dtype
 
+    # Draw accumulators are preallocated at FULL capacity (the total
+    # kept-iteration count) and chunks are written in place with the
+    # old buffer donated (executor.write_draws) — a growing concat
+    # could never alias the donated buffer (shape mismatch), so it
+    # held old + new + output live at every chunk boundary. The
+    # region at [0, it - n_burn_in) is filled; the tail stays zero
+    # until the run completes (finalize only ever sees a full
+    # buffer).
+    n_kept = cfg.n_samples - cfg.n_burn_in
+
     def empty_draws():
         lead = (k,) if cfg.n_chains == 1 else (k, cfg.n_chains)
         return (
-            jnp.zeros(lead + (0, d_par), dtype),
-            jnp.zeros(lead + (0, d_w), dtype),
+            jnp.zeros(lead + (n_kept, d_par), dtype),
+            jnp.zeros(lead + (n_kept, d_w), dtype),
         )
+
+    def to_capacity(draws):
+        """Pad a checkpointed accumulator up to full capacity —
+        save() serializes only the filled draws region (exactly the
+        iterations recorded at save time), so every load re-creates
+        the zero tail. (Pre-change grown-concat checkpoints share
+        this on-disk layout, but the run-identity stamp — which
+        hashes the config repr, now including fused_build — already
+        rejects cross-build resumes before shapes matter.)"""
+        short = n_kept - draws.shape[-2]
+        if short == 0:
+            return draws
+        pad = [(0, 0)] * (draws.ndim - 2) + [(0, short), (0, 0)]
+        return jnp.pad(draws, pad)
 
     meta = np.asarray(
         [cfg.n_samples, cfg.n_burn_in, k, d_par, d_w, cfg.n_chains],
@@ -338,10 +363,14 @@ def fit_subsets_chunked(
     )
     ident = _run_identity(cfg, key, data, beta_init)
     version = np.asarray([CKPT_VERSION], np.int64)
+    # shape-only template leaves for the draws too — materializing the
+    # full-capacity accumulators just to carry the treedef would spike
+    # device memory by exactly the buffers the donation work trims
+    draws_like = jax.eval_shape(empty_draws)
     like = {
         "state": init_like,
-        "param_draws": empty_draws()[0],
-        "w_draws": empty_draws()[1],
+        "param_draws": draws_like[0],
+        "w_draws": draws_like[1],
         "it": np.asarray([0], np.int64),
         "meta": meta,
         "ident": ident,
@@ -384,8 +413,8 @@ def fit_subsets_chunked(
             )
         # leaves arrive as numpy (PRNG keys re-wrapped by load_pytree)
         state = ckpt["state"]
-        param_draws = jnp.asarray(ckpt["param_draws"], dtype)
-        w_draws = jnp.asarray(ckpt["w_draws"], dtype)
+        param_draws = to_capacity(jnp.asarray(ckpt["param_draws"], dtype))
+        w_draws = to_capacity(jnp.asarray(ckpt["w_draws"], dtype))
         it = int(np.asarray(ckpt["it"])[0])
         if put is not None:
             state = put(state)
@@ -399,12 +428,17 @@ def fit_subsets_chunked(
     def save():
         if checkpoint_path is None:
             return
+        # checkpoint only the FILLED draws region — the capacity tail
+        # is zeros by construction, so serializing it would price every
+        # burn-in checkpoint at the full end-of-run size; to_capacity
+        # pads the accumulators back on load
+        filled = max(0, it - cfg.n_burn_in)
         save_pytree(
             checkpoint_path,
             {
                 "state": state,
-                "param_draws": param_draws,
-                "w_draws": w_draws,
+                "param_draws": param_draws[..., :filled, :],
+                "w_draws": w_draws[..., :filled, :],
                 "it": np.asarray([it], np.int64),
                 "meta": meta,
                 "ident": ident,
@@ -476,10 +510,15 @@ def fit_subsets_chunked(
         state, (pd, wd) = chunk_fn("samp", n)(
             data, state, jnp.asarray(it)
         )
-        # draws accumulate on the iteration axis — axis 1 for a single
-        # chain (K, it, d), axis 2 with chains (K, C, it, d)
-        param_draws = jnp.concatenate([param_draws, pd], axis=-2)
-        w_draws = jnp.concatenate([w_draws, wd], axis=-2)
+        # draws land at [it - n_burn, it - n_burn + n) on the
+        # iteration axis of the PREALLOCATED accumulators — axis 1
+        # for a single chain (K, kept, d), axis 2 with chains
+        # (K, C, kept, d) — with the old buffer DONATED into the
+        # same-shaped update output on donation-capable backends
+        # (executor.write_draws; shape-matching is what makes the
+        # donation actually alias, unlike a growing concat).
+        param_draws = write_draws(param_draws, pd, it - n_burn)
+        w_draws = write_draws(w_draws, wd, it - n_burn)
         it += n
         guard()
         report("sample", n_burn)

@@ -31,6 +31,22 @@ def mtm_chol_scope():
     return jax.named_scope(MTM_CHOL_SCOPE)
 
 
+# Named profiler scope wrapping every fused correlation-build kernel
+# invocation (ops/pallas_build.py, SMKConfig.fused_build="pallas").
+# Same contract as MTM_CHOL_SCOPE: one module-level name shared by the
+# emitting site and every profile consumer, so any eff_hbm_gbps /
+# build-phase GB/s movement attributed to the fused-build change shows
+# up under exactly this scope.
+FUSED_BUILD_SCOPE = "fused_corr_build"
+
+
+def fused_build_scope():
+    """jax.named_scope for the Pallas fused correlation build — use as
+    ``with fused_build_scope():`` around each tiled coords→correlation
+    kernel call."""
+    return jax.named_scope(FUSED_BUILD_SCOPE)
+
+
 def device_sync(tree: Any) -> None:
     """Force real completion of every array in ``tree``.
 

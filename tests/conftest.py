@@ -25,6 +25,105 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
 
 
+import pytest  # noqa: E402
+
+# Slow-inventory ENFORCEMENT state (tests/test_zz_slow_gate.py): the
+# collection hook records which nodeids carry the slow marker, the
+# runtest hook records every test's measured call duration from
+# pytest's own report objects, and an over-budget UNMARKED test in an
+# enforced (non-grandfathered) file is failed IN-FLIGHT — its own
+# report is flipped to failed the moment it finishes. In-flight is
+# load-bearing: the ROADMAP tier-1 command runs under a hard 870 s
+# timeout that kills the session mid-suite (rc=124 at seed), so any
+# end-of-session check can be dead code on exactly the runs the
+# budget protects; the makereport flip fires wherever the timeout
+# lands. tests/test_zz_slow_gate.py unit-tests the hook logic and
+# re-checks the whole session on complete runs. This turns the
+# advisory "[slow inventory]" print into a hard check: expensive new
+# tests cannot silently erode the tier-1 870 s window.
+SLOW_MARKED_IDS: set = set()
+CALL_DURATIONS: dict = {}  # nodeid -> measured call-phase seconds
+FLIPPED_IDS: set = set()  # nodeids the in-flight gate already failed
+
+# Pre-existing test files at the time the gate was introduced (r7) —
+# their unmarked budget is the status quo the 870 s window already
+# prices in (measured: test_meta_e2e single tests up to ~194 s here).
+# Everything else — all FUTURE test files, plus the r7 files, which
+# measure well under budget — is enforced. Tighten by deleting
+# entries as files get cleaned up.
+SLOW_GATE_GRANDFATHERED = {
+    "test_bench_outage.py",
+    "test_chains_diagnostics.py",
+    "test_config_warnings.py",
+    "test_data_ebird.py",
+    "test_distributed.py",
+    "test_factor_reuse.py",
+    "test_graft_entry.py",
+    "test_meta_e2e.py",
+    "test_ops.py",
+    "test_partition_combine.py",
+    "test_phi_mtm.py",
+    "test_polya_gamma.py",
+    "test_r_frontend.py",
+    "test_recovery.py",
+    "test_sampler.py",
+    "test_sharded_chol.py",
+    "test_utils.py",
+}
+
+
+def slow_gate_threshold_s() -> float:
+    return float(os.environ.get("SMK_SLOW_GATE_S", "60"))
+
+
+def _is_grandfathered(path: str) -> bool:
+    """True only for the baseline files AT THE SUITE ROOT: the path
+    must BE the bare name (pytest invoked from tests/) or end with
+    "tests/<name>" — a future tests/subdir/test_ops.py reusing a
+    baseline basename is NOT exempt."""
+    norm = path.replace(os.sep, "/")
+    return any(
+        norm == name or norm.endswith("tests/" + name)
+        for name in SLOW_GATE_GRANDFATHERED
+    )
+
+
+def slow_gate_offense(nodeid: str, duration: float, is_slow: bool):
+    """The one definition of a slow-gate offense: an UNMARKED test in
+    an enforced file whose call phase exceeded the threshold. Returns
+    the failure message, or None."""
+    if is_slow or _is_grandfathered(nodeid.split("::", 1)[0]):
+        return None
+    threshold = slow_gate_threshold_s()
+    if duration <= threshold:
+        return None
+    return (
+        f"[slow gate] {nodeid} took {duration:.1f}s unmarked — over "
+        f"the {threshold:.0f}s tier-1 per-test budget (ROADMAP 870 s "
+        "window); mark it @pytest.mark.slow or raise SMK_SLOW_GATE_S"
+    )
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    report = outcome.get_result()
+    if report.when != "call":
+        return
+    CALL_DURATIONS[report.nodeid] = report.duration
+    if not report.passed:
+        return
+    msg = slow_gate_offense(
+        report.nodeid,
+        report.duration,
+        item.get_closest_marker("slow") is not None,
+    )
+    if msg is not None:
+        FLIPPED_IDS.add(report.nodeid)
+        report.outcome = "failed"
+        report.longrepr = msg
+
+
 def pytest_collection_modifyitems(config, items):
     """Print the slow-marker inventory at collection time.
 
@@ -40,6 +139,8 @@ def pytest_collection_modifyitems(config, items):
     n_slow = 0
     for item in items:
         is_slow = item.get_closest_marker("slow") is not None
+        if is_slow:
+            SLOW_MARKED_IDS.add(item.nodeid)
         n_slow += is_slow
         fast, slow = per_file.get(item.location[0], (0, 0))
         per_file[item.location[0]] = (

@@ -1,0 +1,80 @@
+"""Distance-build guarantees (ops/distance.py).
+
+The XLA build uses the norm-trick expansion ||a||^2 + ||b||^2 - 2 a.b
+so the O(m^2 d) work is one MXU GEMM; these tests pin its two
+contracts against the naive per-pair form:
+
+1. fp32-TOLERANCE parity, not bitwise — the expansion reassociates
+   the fp32 sums, and on this backend identical math compiles to
+   different low bits per module context anyway (the XLA CPU
+   bit-stability note), so the right check is a tolerance band around
+   the cancellation-free per-pair reference.
+2. EXACT-zero diagonal — the matmul expansion leaves ~1e-4 residue at
+   a[i].a[i] which pairwise_distance must force to exact zero (the
+   correlation diagonal, and through it the Cholesky conditioning,
+   depends on it).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from smk_tpu.ops.distance import cross_distance, pairwise_distance
+
+
+def _naive_pairwise(a, b):
+    """Cancellation-free per-pair reference (float64 accumulation)."""
+    a64 = np.asarray(a, np.float64)
+    b64 = np.asarray(b, np.float64)
+    diff = a64[:, None, :] - b64[None, :, :]
+    return np.sqrt((diff * diff).sum(-1))
+
+
+@pytest.fixture
+def coords():
+    key = jax.random.key(11)
+    return jax.random.uniform(key, (97, 2), jnp.float32, 0.0, 3.0)
+
+
+class TestNormTrickParity:
+    def test_pairwise_matches_naive_fp32(self, coords):
+        got = np.asarray(pairwise_distance(coords))
+        want = _naive_pairwise(coords, coords)
+        # fp32 tolerance: sq entries are O(10), eps32 ~ 1.2e-7, and
+        # the sqrt halves the relative error away from zero; near-zero
+        # distances are covered by the absolute term
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=5e-4)
+
+    def test_cross_matches_naive_fp32(self, coords):
+        b = coords[:13] + 0.05
+        got = np.asarray(cross_distance(coords, b))
+        want = _naive_pairwise(coords, b)
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=5e-4)
+
+    def test_pairwise_symmetric_exact(self, coords):
+        d = np.asarray(pairwise_distance(coords))
+        assert np.array_equal(d, d.T), "symmetrization must be exact"
+
+
+class TestExactZeroDiagonal:
+    def test_diagonal_exact_zero(self, coords):
+        d = np.asarray(pairwise_distance(coords))
+        assert (np.diagonal(d) == 0.0).all(), (
+            "fp32 cancellation residue must be forced to exact zero "
+            "on the diagonal"
+        )
+
+    def test_duplicate_points_nonnegative(self):
+        # coincident rows: the norm trick's a2 + b2 - 2ab can go
+        # slightly negative before the clamp — the sqrt must never
+        # see it (NaN would poison the whole correlation build)
+        key = jax.random.key(3)
+        pts = jax.random.uniform(key, (8, 2), jnp.float32)
+        coords = jnp.concatenate([pts, pts], axis=0)  # every point twice
+        d = np.asarray(pairwise_distance(coords))
+        assert np.isfinite(d).all()
+        assert (d >= 0.0).all()
+        # the duplicate pairs are off-diagonal zeros up to fp residue
+        dup = np.diagonal(d[:8, 8:])
+        assert (np.abs(dup) < 1e-3).all()

@@ -1,0 +1,151 @@
+"""Enforced slow-inventory gate (tier-1 window protection).
+
+The ENFORCEMENT lives in conftest.py: a ``pytest_runtest_makereport``
+hookwrapper flips an over-budget UNMARKED test's own report to failed
+the moment it finishes (in-flight — the ROADMAP tier-1 command runs
+under a hard 870 s timeout that kills the session mid-suite, so an
+end-of-session-only check could be dead code on exactly the runs the
+budget protects). This file unit-tests that hook's logic and, named
+to collect alphabetically last (``-p no:randomly`` keeps collection
+order), re-checks the whole recorded session as a backstop on
+complete runs.
+
+Grandfathered baseline (conftest.SLOW_GATE_GRANDFATHERED): the tier-1
+window was ALREADY oversubscribed before this gate existed (the
+ROADMAP command times out mid-suite by design — DOTS_PASSED counts
+what finished), and the pre-existing files carry unmarked tests far
+over any sane per-test budget (measured r7: test_meta_e2e single
+tests up to ~194 s on this host). Retroactively slow-marking them
+would empty the tier-1 gate of its main coverage, so enforcement
+applies to every test file NOT in the baseline — i.e. to ALL FUTURE
+test files, plus the files this PR added (measured well under the
+budget). New expensive tests in a NEW file fail in-flight until
+slow-marked; new tests slipped into a baseline file still show up in
+the "[slow inventory]" audit line.
+
+Threshold: SMK_SLOW_GATE_S (default 60 s) per unmarked test in an
+enforced file — far above compile-heavy-but-honest tier-1 tests in
+the new files (worst measured ~6 s), far below the sampler-scale
+tests the slow marker exists for.
+"""
+
+import conftest
+
+
+def test_unmarked_tests_stayed_inside_tier1_budget():
+    """Complete-run backstop: nothing the in-flight hook enforced
+    slipped through this session (it cannot on a healthy hook — an
+    offense fails its own test — so an offender surfacing HERE means
+    the makereport flip itself regressed)."""
+    offenders = {
+        nodeid: dur
+        for nodeid, dur in conftest.CALL_DURATIONS.items()
+        if nodeid not in conftest.FLIPPED_IDS  # hook already failed it
+        and conftest.slow_gate_offense(
+            nodeid, dur, nodeid in conftest.SLOW_MARKED_IDS
+        )
+        is not None
+    }
+    assert not offenders, (
+        "unmarked tests exceeded the tier-1 per-test budget without "
+        "being failed in-flight — the conftest makereport gate "
+        "regressed: "
+        + ", ".join(
+            f"{nid} ({dur:.1f}s)"
+            for nid, dur in sorted(
+                offenders.items(), key=lambda kv: -kv[1]
+            )
+        )
+    )
+
+
+class TestGateLogic:
+    """Unit tests of conftest.slow_gate_offense — the one definition
+    both the in-flight hook and the backstop above consult."""
+
+    def test_over_budget_unmarked_enforced_file_is_offense(self):
+        msg = conftest.slow_gate_offense(
+            "tests/test_future_feature.py::test_big", 9999.0, False
+        )
+        assert msg is not None and "slow gate" in msg
+
+    def test_slow_marker_exempts(self):
+        assert (
+            conftest.slow_gate_offense(
+                "tests/test_future_feature.py::test_big", 9999.0, True
+            )
+            is None
+        )
+
+    def test_grandfathered_file_exempts(self):
+        assert "test_meta_e2e.py" in conftest.SLOW_GATE_GRANDFATHERED
+        # both invocation spellings the tier-1 gate can produce
+        for path in ("tests/test_meta_e2e.py", "test_meta_e2e.py"):
+            assert (
+                conftest.slow_gate_offense(
+                    f"{path}::test_heavy", 9999.0, False
+                )
+                is None
+            )
+
+    def test_subdir_name_collision_is_not_exempt(self):
+        # a future tests/integration/test_ops.py reusing a baseline
+        # basename must still be enforced
+        msg = conftest.slow_gate_offense(
+            "tests/integration/test_ops.py::test_big", 9999.0, False
+        )
+        assert msg is not None
+
+    def test_under_threshold_passes(self):
+        assert (
+            conftest.slow_gate_offense(
+                "tests/test_future_feature.py::test_ok",
+                conftest.slow_gate_threshold_s() / 2,
+                False,
+            )
+            is None
+        )
+
+    def test_threshold_env_override(self, monkeypatch):
+        monkeypatch.setenv("SMK_SLOW_GATE_S", "123.5")
+        assert conftest.slow_gate_threshold_s() == 123.5
+
+
+def test_gate_instrumentation_recorded_this_session(request):
+    """The gate is only meaningful if the duration hook actually runs.
+    Two non-vacuous checks:
+
+    1. The hook exists under the EXACT name pytest discovers
+       (``pytest_runtest_makereport`` — a rename silently unhooks it)
+       and is the wrapper the flip needs.
+    2. The live wiring: when session items ran before this test
+       (pytest executes ``session.items`` in order), at least one
+       must have left a call-duration record — if earlier tests ran
+       and nothing was recorded, the hook is not being invoked."""
+    hook = getattr(conftest, "pytest_runtest_makereport", None)
+    assert hook is not None, (
+        "conftest.pytest_runtest_makereport missing — the slow "
+        "gate's in-flight enforcement is unhooked"
+    )
+
+    assert isinstance(conftest.SLOW_MARKED_IDS, set)
+    items = request.session.items
+    my_index = next(
+        i
+        for i, it in enumerate(items)
+        if it.nodeid == request.node.nodeid
+    )
+    ran_before = [it.nodeid for it in items[:my_index]]
+    if ran_before:
+        recorded = set(conftest.CALL_DURATIONS)
+        # skipped tests legitimately have no call phase, so require
+        # only that the session recorded SOMETHING when something ran
+        assert recorded & set(ran_before) or all(
+            it.get_closest_marker("skip") is not None
+            or it.get_closest_marker("skipif") is not None
+            for it in items[:my_index]
+        ), (
+            f"{len(ran_before)} tests ran before the slow gate but "
+            "none recorded a call duration — the "
+            "pytest_runtest_makereport hook is not being invoked"
+        )
