@@ -50,6 +50,10 @@ BENCH_CG_DTYPE / BENCH_PHI_EVERY / BENCH_PHI_SAMPLER / BENCH_USOLVER /
 BENCH_CHUNK_ITERS / BENCH_CHOL_BLOCK / BENCH_TRI_BLOCK /
 BENCH_A_PRIOR / BENCH_TEMPER override the solver settings (defaults
 below are the validated scaling-regime configuration).
+BENCH_CHUNK_PIPELINE=sync|overlap selects the chunked executor's host
+loop on every public rung (ISSUE 5; default sync — the historical
+boundary); the chunk_pipeline_ab probe cell measures the sync-vs-
+overlap A/B either way.
 
 Synthetic latent surfaces use random Fourier features (an O(n)
 stationary GP approximation) so data generation never needs an n x n
@@ -328,10 +332,22 @@ def _ebird_triplet(n_total):
     return d.y, d.x, d.coords
 
 
-class RungSkipped(Exception):
+# guarded so an smk_tpu import failure cannot kill bench before the
+# Reporter-first outage protocol is even set up (main() emits partial
+# records from the first rung on)
+try:
+    from smk_tpu.parallel.recovery import ProgressAbort
+except Exception:  # pragma: no cover - import-failure fallback
+    ProgressAbort = Exception  # type: ignore[assignment,misc]
+
+
+class RungSkipped(ProgressAbort):
     """Raised inside run_rung when the measured first-chunk
     extrapolation says the rung cannot finish in the remaining budget;
-    carries the partial rung record."""
+    carries the partial rung record. Subclasses ProgressAbort so the
+    chunked executor's progress-callback hardening (which swallows
+    ordinary callback exceptions) still propagates this deliberate
+    abort out of fit_subsets_chunked."""
 
     def __init__(self, record):
         self.record = record
@@ -444,6 +460,12 @@ def rung_config(env, *, k, n_samples, cov_model, link, n_chains=1,
         # (default off = the historical chain bit-exactly; the
         # config5_fused_ab probe measures the kernel-level A/B)
         fused_build=env.get("BENCH_FUSED_BUILD", "off"),
+        # overlapped chunk pipeline (ISSUE 5): BENCH_CHUNK_PIPELINE
+        # =overlap makes every public rung's host loop snapshot chunk
+        # t asynchronously and dispatch t+1 before guard/report/
+        # checkpoint host work (bit-identical draws either way; the
+        # record's `pipeline` block carries the measured stall split)
+        chunk_pipeline=env.get("BENCH_CHUNK_PIPELINE", "sync"),
         chol_block_size=int(env.get("BENCH_CHOL_BLOCK", 0)),
         # blocked-GEMM trisolves with carried panel inverses: XLA's
         # native trisolve is latency-bound at these shapes (measured
@@ -597,7 +619,7 @@ def run_rung_public(name, *, n, k, cov_model, n_samples, q=1, p=2,
     #2 evidence.
     """
     from smk_tpu.parallel.recovery import fit_subsets_chunked
-    from smk_tpu.utils.tracing import device_sync
+    from smk_tpu.utils.tracing import ChunkPipelineStats, device_sync
 
     env = solver_env or {}
     t_rung_start = time.time()
@@ -656,6 +678,7 @@ def run_rung_public(name, *, n, k, cov_model, n_samples, q=1, p=2,
             prev_t, prev_it = now, itn
         return out
 
+    pstats = ChunkPipelineStats()
     res = fit_subsets_chunked(
         model, part, coords_test, x_test, jax.random.key(2), beta0,
         chunk_iters=chunk_iters, nan_guard=True, progress=on_chunk,
@@ -664,6 +687,7 @@ def run_rung_public(name, *, n, k, cov_model, n_samples, q=1, p=2,
         # workspaces) measured 17.7 G against the 15.75 G chip in one
         # dispatch — lax.map over K-chunks halves it at ~equal work
         chunk_size=chunk_size,
+        pipeline_stats=pstats,
     )
     device_sync((res.param_grid, res.w_grid))
     wall_s = time.time() - t0
@@ -736,6 +760,17 @@ def run_rung_public(name, *, n, k, cov_model, n_samples, q=1, p=2,
             "max": round(max(rates), 1),
         },
         "fit_s_at_best_rate": round(min(rates) * n_samples / 1e3, 1),
+        # ISSUE 5: the RESOLVED host-loop mode (never an aspirational
+        # value — cfg validation pins it to sync|overlap) plus the
+        # measured per-chunk dispatch/host-stall/D2H split from
+        # utils/tracing.ChunkPipelineStats; overlap_efficiency is the
+        # fraction of the loop wall during which the device had a
+        # chunk queued
+        "chunk_pipeline": cfg.chunk_pipeline,
+        "pipeline": {
+            k_: v for k_, v in pstats.aggregate().items()
+            if k_ != "ckpt_boundary_bytes"
+        },
     }
     return rung_diagnostics(
         record, res, cfg, m=m, k=k, q=q, p_dim=p, n_samples=n_samples,
@@ -1419,6 +1454,71 @@ def measure_fused_build(*, m=3906, j_tries=(1, 4), reps=3,
     }
 
 
+def measure_chunk_pipeline(*, n=768, k=4, n_samples=120,
+                           chunk_iters=20):
+    """Sync-vs-overlap A/B on the chunked executor (ISSUE 5) — the
+    in-bench companion of scripts/async_pipe_probe.py: the SAME
+    model/partition/key run through fit_subsets_chunked under both
+    ``chunk_pipeline`` modes with a real (tmpdir) checkpoint, so the
+    cell carries measured host-stall seconds, the per-boundary
+    checkpoint bytes (flat in the iteration counter — the v5
+    incremental-segment claim), and the bit-identity of the final
+    draws across modes. Backend-agnostic by design: the host-loop
+    overlap is about D2H fetches + file I/O vs device dispatch, which
+    exists on CPU too (unlike the fused-build A/B's HBM claim).
+    """
+    import dataclasses
+    import tempfile
+
+    from smk_tpu.config import SMKConfig
+    from smk_tpu.models.probit_gp import SpatialGPSampler
+    from smk_tpu.parallel.partition import random_partition
+    from smk_tpu.parallel.recovery import fit_subsets_chunked
+    from smk_tpu.utils.tracing import ChunkPipelineStats
+
+    y, x, coords = make_binary_field(jax.random.key(7), n, q=1, p=2)
+    part = random_partition(jax.random.key(1), y, x, coords, k)
+    base = SMKConfig(
+        n_subsets=k, n_samples=n_samples, burn_in_frac=0.5,
+        phi_update_every=4,
+    )
+    cells, draws = [], {}
+    with tempfile.TemporaryDirectory() as td:
+        for mode in ("sync", "overlap"):
+            cfg = dataclasses.replace(base, chunk_pipeline=mode)
+            model = SpatialGPSampler(cfg, weight=1)
+            pstats = ChunkPipelineStats()
+            res = fit_subsets_chunked(
+                model, part, coords[:4], x[:4], jax.random.key(2),
+                chunk_iters=chunk_iters,
+                checkpoint_path=os.path.join(td, f"{mode}.npz"),
+                nan_guard=True, pipeline_stats=pstats,
+            )
+            draws[mode] = np.asarray(res.param_samples)
+            agg = pstats.aggregate()
+            agg.pop("mode")  # the cell's chunk_pipeline field
+            bnd = agg.pop("ckpt_boundary_bytes")
+            # O(chunk) check: SAMPLING-phase boundary bytes (the only
+            # ones that carry a draw segment) must not grow with the
+            # iteration counter; the historical format's O(it) curve
+            # roughly doubles over the sampling half of this run
+            samp = bnd[cfg.n_burn_in // chunk_iters:]
+            agg["ckpt_bytes_flat_in_it"] = bool(
+                samp and max(samp) <= int(min(samp) * 1.25)
+            )
+            agg["ckpt_boundary_bytes"] = bnd
+            cells.append({"chunk_pipeline": mode, **agg})
+    return {
+        "rung": "chunk_pipeline_ab",
+        "n": n, "K": k, "m": part.x.shape[1], "iters": n_samples,
+        "chunk_iters": chunk_iters,
+        "bitwise_identical_draws": bool(
+            np.array_equal(draws["sync"], draws["overlap"])
+        ),
+        "cells": cells,
+    }
+
+
 def _probe_backend(attempts, wait_s):
     """Initialize-or-fall-back backend probe, run BEFORE the parent
     process touches its own JAX backend (VERDICT r5 #1: a dead TPU
@@ -1693,6 +1793,20 @@ def main():
         except Exception as e:
             reporter.ladder.append(
                 {"rung": "config5_fused_ab", "error": repr(e)}
+            )
+            reporter.emit(partial=True)
+
+    # Overlapped-pipeline A/B (ISSUE 5): sync-vs-overlap host-loop
+    # stall split + per-boundary checkpoint bytes + cross-mode draw
+    # bit-identity at CPU-sized shapes — same budget/fallibility
+    # policy as the other probe cells (Reporter-first: a probe crash
+    # appends an error record, never loses the ladder).
+    if left() > 90 and os.environ.get("BENCH_PIPE_AB", "1") != "0":
+        try:
+            reporter.add_rung(measure_chunk_pipeline())
+        except Exception as e:
+            reporter.ladder.append(
+                {"rung": "chunk_pipeline_ab", "error": repr(e)}
             )
             reporter.emit(partial=True)
 

@@ -61,6 +61,7 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
                                                         "student_t",
                                                         "mixture"),
                                 fused.build = c("off", "pallas"),
+                                chunk.pipeline = c("sync", "overlap"),
                                 n.report = NULL,
                                 checkpoint.path = NULL,
                                 backend = c("tpu", "cpu"),
@@ -103,9 +104,20 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
   # (the reference's n.report batch printouts, R:84) — the fit then
   # runs through the chunked executor. checkpoint.path: if set, the
   # fit checkpoints each chunk and an interrupted call resumes.
+  # chunk.pipeline: the chunked executor's host loop. "sync"
+  # (default) blocks between compiled chunks for the progress/guard
+  # fetches and the checkpoint write; "overlap" snapshots each
+  # chunk's outputs with async device-to-host copies and dispatches
+  # the next chunk FIRST, running those host steps (checkpoint
+  # writes on a background thread) while the accelerator computes —
+  # the draws are bit-identical either way, so "overlap" is purely a
+  # throughput lever for long checkpointed fits (see the README's
+  # overlapped-pipeline section; a background write failure warns
+  # and falls back to synchronous writes).
   k.prior <- match.arg(k.prior)
   phi.proposal.family <- match.arg(phi.proposal.family)
   fused.build <- match.arg(fused.build)
+  chunk.pipeline <- match.arg(chunk.pipeline)
   # link: the reference workflow is logit (spMvGLM binomial fit,
   # 1/(1+exp(-eta)) at MetaKriging_BinaryResponse.R:160); the TPU
   # default is the exact Albert–Chib probit sampler. Users porting the
@@ -154,6 +166,7 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
     phi_proposals = as.integer(phi.proposals),
     phi_proposal_family = phi.proposal.family,
     fused_build = fused.build,
+    chunk_pipeline = chunk.pipeline,
     priors = smk$PriorConfig(a_prior = k.prior)
   ), config.overrides)
   cfg <- do.call(smk$SMKConfig, cfg_args)

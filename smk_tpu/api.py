@@ -157,6 +157,7 @@ def fit_meta_kriging(
     checkpoint_every: int = 500,
     progress=None,
     nan_guard: bool = False,
+    pipeline_stats=None,
 ) -> MetaKrigingResult:
     """Full spatial-meta-kriging pipeline.
 
@@ -174,16 +175,30 @@ def fit_meta_kriging(
       iterations per compiled dispatch (required at scales where a
       single whole-run dispatch cannot survive the execution
       environment); implied by ``checkpoint_path``/``progress``.
-    - ``checkpoint_path``: atomic checkpoint every chunk (every
+    - ``checkpoint_path``: checkpoint every chunk (every
       ``checkpoint_every`` iterations unless ``chunk_iters`` is set);
-      an interrupted call resumes bit-exactly.
+      format v5 writes an O(1)-sized manifest plus one O(chunk) draw
+      segment per sampling chunk, all atomic-renamed; an interrupted
+      call resumes bit-exactly.
     - ``progress``: per-chunk callback(dict) with iteration count and
-      running phi acceptance (reference n.report parity, R:84).
+      running phi acceptance (reference n.report parity, R:84). A
+      callback that raises is caught with a one-time warning and the
+      run continues (raise a parallel.recovery.ProgressAbort subclass
+      to abort deliberately).
     - ``nan_guard``: per-chunk in-chain NaN/inf check on the carried
       state; raises parallel.recovery.SubsetNaNError naming the failed
       subsets before the checkpoint is overwritten (implies chunked
       execution). Post-hoc detection (find_failed_subsets /
       rerun_subsets) remains for the unchunked paths.
+    - ``pipeline_stats``: optional utils.tracing.ChunkPipelineStats
+      sink for per-chunk dispatch/host-stall/D2H/checkpoint metrics
+      on the chunked path.
+
+    ``config.chunk_pipeline`` selects the chunked executor's host
+    loop: ``"sync"`` (the historical serial boundary) or
+    ``"overlap"`` (async snapshots + background checkpoint writes;
+    guard/report/checkpoint for chunk t run while the device computes
+    chunk t+1). Final draws are bit-identical across modes.
     """
     cfg = config or SMKConfig()
     times = PhaseTimes()
@@ -274,6 +289,7 @@ def fit_meta_kriging(
                 chunk_size=chunk_size,
                 progress=progress,
                 nan_guard=nan_guard,
+                pipeline_stats=pipeline_stats,
             )
         elif sharded or mesh is not None:
             results = fit_subsets_sharded(

@@ -19,6 +19,7 @@ COV_MODELS = ("exponential", "matern32", "matern52")
 LINKS = ("probit", "logit")
 COMBINERS = ("wasserstein_mean", "weiszfeld_median")
 PHI_PROPOSAL_FAMILIES = ("gaussian", "student_t", "mixture")
+CHUNK_PIPELINES = ("sync", "overlap")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -300,6 +301,31 @@ class SMKConfig:
     # one-time warning (ops/pallas_build.resolve_fused_build).
     fused_build: str = "off"
 
+    # Chunked-executor host pipeline (parallel/recovery.py
+    # fit_subsets_chunked / fit_subsets_checkpointed):
+    # - "sync" (default): the historical loop — after each compiled
+    #   chunk the host blocks on the NaN guard / progress fetches and
+    #   the checkpoint write before dispatching the next chunk. The
+    #   carried chain is bit-identical to every prior round (the chunk
+    #   programs themselves are untouched by this knob).
+    # - "overlap": the host snapshots chunk t's outputs with async
+    #   device-to-host copies and dispatches chunk t+1 BEFORE doing
+    #   any host work, so guard/report/checkpoint for chunk t run
+    #   while the device computes t+1 (the CheckFreq-style
+    #   compute/I-O overlap; SMK's share-nothing fan-out makes chunk
+    #   t+1 depend only on the carried state, so chunk t's host work
+    #   is overlappable by construction). Checkpoint writes go through
+    #   a single background writer thread (strictly ordered, atomic
+    #   renames preserved; a write error is surfaced as a warning at
+    #   the next boundary and the run degrades to synchronous writes).
+    #   Final draws are bit-identical to "sync": both modes run the
+    #   SAME compiled chunk/write programs — the pipeline only moves
+    #   host work off the device's critical path. Snapshots are taken
+    #   before the donated re-dispatch, so donation stays safe.
+    # Checkpoints are format v5 (incremental per-chunk segments) in
+    # BOTH modes — see parallel/recovery.py.
+    chunk_pipeline: str = "sync"
+
     # Blocked-GEMM Cholesky for the phi-MH proposal factorization (the
     # one remaining O(m^3) kernel): 0 = XLA's native cholesky; > 0 =
     # ops/chol.py blocked_cholesky with this block size (the same
@@ -448,6 +474,10 @@ class SMKConfig:
         if self.fused_build not in ("off", "pallas"):
             raise ValueError(
                 "fused_build must be 'off' or 'pallas'"
+            )
+        if self.chunk_pipeline not in CHUNK_PIPELINES:
+            raise ValueError(
+                f"chunk_pipeline must be one of {CHUNK_PIPELINES}"
             )
         if self.chol_block_size < 0:
             raise ValueError("chol_block_size must be >= 0 (0 = XLA)")

@@ -82,6 +82,18 @@ def init_distributed(
         kwargs["process_id"] = process_id
     if local_device_ids is not None:
         kwargs["local_device_ids"] = local_device_ids
+    plats = jax.config.jax_platforms
+    if plats is None or plats.split(",")[0] == "cpu":
+        # XLA:CPU's default collectives stub rejects multi-process
+        # programs outright ("Multiprocess computations aren't
+        # implemented on the CPU backend") — the Gloo transport is
+        # the documented CPU implementation and must be selected
+        # BEFORE the backend initializes. Also set when no platform
+        # is pinned (plats None — the default on CPU-only installs,
+        # where the resolved backend IS cpu); a no-op whenever a
+        # non-CPU backend wins resolution, since only the CPU client
+        # reads this config.
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(**kwargs)
     return ProcessTopology(
         process_id=jax.process_index(),

@@ -38,6 +38,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from smk_tpu.models.probit_gp import SpatialGPSampler, SubsetData, SubsetResult
@@ -102,6 +103,69 @@ def write_draws(
     if _backend_supports_donation():
         return _write_draws_donated(acc, new, offset)
     return _write_draws_plain(acc, new, offset)
+
+
+@jax.jit
+def _device_clone(leaf):
+    """A genuinely new device buffer holding ``leaf``'s value (jit
+    outputs never alias undonated inputs)."""
+    return jnp.copy(leaf)
+
+
+def tree_nbytes(tree) -> int:
+    """Total array bytes across a pytree's dtype-carrying leaves —
+    the ONE definition both pipeline modes' D2H accounting uses
+    (HostSnapshot here, the sync boundary in parallel/recovery.py),
+    so the sync-vs-overlap byte comparison cannot drift."""
+    return sum(
+        int(np.size(l)) * getattr(l.dtype, "itemsize", 4)
+        for l in jax.tree_util.tree_leaves(tree)
+        if hasattr(l, "dtype")
+    )
+
+
+class HostSnapshot:
+    """Async device→host snapshot of an array pytree whose buffers
+    are about to be DONATED.
+
+    Construction dispatches a tiny on-device clone of every leaf —
+    typed PRNG keys are first lowered to their raw key data — and
+    issues non-blocking ``copy_to_host_async`` copies of the clones;
+    :meth:`get` materializes the numpy tree, blocking only on
+    whatever hasn't landed yet. The clone step is what makes the
+    overlap chunk pipeline (parallel/recovery.py) donation-safe: JAX
+    invalidates a donated Array handle at dispatch time on EVERY
+    backend (even CPU, where the runtime ignores the aliasing hint),
+    so snapshotting chunk t's carried state must capture new buffers
+    before chunk t+1's donated re-dispatch — the clone executes on
+    the device stream between the two chunk programs, costing one
+    state-sized device copy, never a blocking host fetch on the
+    dispatch path. For numpy leaves (e.g. a just-resumed state) this
+    degrades to a plain deferred fetch.
+    """
+
+    def __init__(self, tree):
+        def prep(leaf):
+            dt = getattr(leaf, "dtype", None)
+            if dt is not None and jax.dtypes.issubdtype(
+                dt, jax.dtypes.prng_key
+            ):
+                leaf = jax.random.key_data(leaf)
+            if isinstance(leaf, jax.Array):
+                leaf = _device_clone(leaf)
+                try:
+                    leaf.copy_to_host_async()
+                except Exception:  # pragma: no cover - backend quirk
+                    pass
+            return leaf
+
+        self._tree = jax.tree_util.tree_map(prep, tree)
+        self.nbytes = tree_nbytes(self._tree)
+
+    def get(self):
+        """The snapshot as a numpy pytree (blocks if copies are still
+        in flight)."""
+        return jax.tree_util.tree_map(np.asarray, self._tree)
 
 
 def stacked_subset_data(

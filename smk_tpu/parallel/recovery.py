@@ -22,6 +22,8 @@ Here both durability subsystems are real:
 from __future__ import annotations
 
 import os
+import time
+import warnings
 import zlib
 from typing import Optional, Sequence
 
@@ -37,6 +39,8 @@ from smk_tpu.models.probit_gp import (
 )
 from smk_tpu.parallel.executor import (
     DATA_AXES,
+    HostSnapshot,
+    tree_nbytes,
     write_draws,
     init_subset_states,
     stacked_subset_data,
@@ -44,15 +48,37 @@ from smk_tpu.parallel.executor import (
     subset_runner,
 )
 from smk_tpu.parallel.partition import Partition
-from smk_tpu.utils.checkpoint import load_pytree, save_pytree
+from smk_tpu.utils.checkpoint import (
+    BackgroundWriter,
+    load_pytree,
+    load_segment,
+    save_pytree,
+    save_segment,
+    segment_path,
+)
+from smk_tpu.utils.tracing import ChunkPipelineStats
 
 
 # Checkpoint format version. v2 added the run-identity fingerprint;
 # v3 the explicit iteration counter (burn-in chunks checkpoint too);
 # v4 the n_chains meta field + the sampled (no full-array host fetch)
-# run-identity scheme. A bump invalidates older files with a clear
-# error instead of a generic structure mismatch.
-CKPT_VERSION = 4
+# run-identity scheme; v5 the incremental draw-segment layout — the
+# file at checkpoint_path becomes a MANIFEST (carried state + counters
+# only, O(1) in the iteration count) and each chunk boundary appends
+# one `<path>.segNNNNN.npz` file holding only that chunk's new kept
+# draws, so per-boundary checkpoint I/O is O(chunk) instead of
+# re-serializing the whole filled draws region (O(it)). A bump
+# invalidates older files with a clear error instead of a generic
+# structure mismatch.
+CKPT_VERSION = 5
+
+
+class ProgressAbort(Exception):
+    """Base class for exceptions a ``progress`` callback may raise to
+    DELIBERATELY abort a chunked run (bench.py's RungSkipped budget
+    gate subclasses this). Any other exception from a user callback is
+    caught, warned about once, and the run keeps sampling — a broken
+    logging hook must not kill a multi-hour fan-out mid-flight."""
 
 
 class SubsetNaNError(RuntimeError):
@@ -85,6 +111,24 @@ def _finite_subsets(state) -> jnp.ndarray:
         for leaf in (state.beta, state.u, state.a, state.phi)
     ]
     return jnp.stack(oks).all(axis=0)
+
+
+@jax.jit
+def _chunk_stats(state):
+    """Device-side guard + report statistics for one chunk boundary:
+    ``(finite, accept_mean)`` where ``finite`` is the (K,) per-subset
+    all-small-leaves-finite vector (exactly ``_finite_subsets``) and
+    ``accept_mean`` is the scalar mean of the running phi-acceptance
+    counters. One tiny compiled program, K+4 bytes across the wire —
+    the chunk boundary's host fetches never touch the full carried
+    state. Kept OUTSIDE the chunk program deliberately: fusing these
+    reductions into the chunk module would change its XLA compilation
+    context, and XLA:CPU compiles identical fp32 arithmetic to
+    different low bits per module — which would break the
+    sync-vs-overlap bit-identical-draws contract the pipeline is
+    golden-pinned to (both modes dispatch the SAME chunk programs;
+    this stats program reads, never writes, the carry)."""
+    return _finite_subsets(state), jnp.mean(state.phi_accept)
 
 
 def _key_bytes(key) -> bytes:
@@ -165,8 +209,16 @@ def _run_identity(cfg, key, data, beta_init) -> np.ndarray:
     warm start (see _leaf_fingerprint). A checkpoint written under a
     different identity is rejected instead of being silently
     resumed/returned (two runs differing only in cov_model, key, or
-    data have identical array shapes)."""
-    crcs = [zlib.crc32(repr(cfg).encode())]
+    data have identical array shapes). ``chunk_pipeline`` is
+    NORMALIZED out of the hash: both pipeline modes dispatch the same
+    compiled chunk programs and produce bit-identical chains, so a
+    run checkpointed under "overlap" must be resumable under "sync"
+    (the operational escape hatch when a background writer
+    misbehaves) and vice versa."""
+    import dataclasses
+
+    cfg_ident = dataclasses.replace(cfg, chunk_pipeline="sync")
+    crcs = [zlib.crc32(repr(cfg_ident).encode())]
     crcs.append(zlib.crc32(_key_bytes(key)))
     for leaf in jax.tree_util.tree_leaves(data):
         crcs.append(_leaf_fingerprint(leaf))
@@ -224,6 +276,276 @@ def _make_chunk_fn(model, kind, length, k, chunk_size):
     return jax.jit(chunked, donate_argnums=(1,))
 
 
+def _read_segments(path, seg_base, n_segments, filled, dtype):
+    """Assemble the filled kept-draw region from the v5 segment files
+    seg_base..seg_base+n_segments-1, validating contiguous coverage
+    [0, filled). Returns (param, w) numpy arrays of filled length (or
+    (None, None) when nothing is filled yet)."""
+    if filled <= 0:
+        if n_segments != 0:
+            raise ValueError(
+                f"checkpoint {path} is inconsistent: {n_segments} "
+                "segments recorded but no filled draws"
+            )
+        return None, None
+    parts_p, parts_w = [], []
+    cursor = 0
+    for i in range(seg_base, seg_base + n_segments):
+        try:
+            seg = load_segment(path, i)
+        except (OSError, KeyError, ValueError) as e:
+            raise ValueError(
+                f"checkpoint {path} is missing or has a corrupt draw "
+                f"segment {segment_path(path, i)} — the manifest "
+                f"records {n_segments} segments covering {filled} "
+                "kept draws; restore the file or delete the "
+                "checkpoint and re-run"
+            ) from e
+        if seg["start"] != cursor or seg["stop"] <= seg["start"]:
+            raise ValueError(
+                f"checkpoint {path} segments are not contiguous: "
+                f"segment {i} covers [{seg['start']}, {seg['stop']}) "
+                f"but {cursor} was expected next"
+            )
+        if seg["param"].shape[-2] != seg["stop"] - seg["start"]:
+            raise ValueError(
+                f"checkpoint {path} segment {i} shape "
+                f"{seg['param'].shape} does not match its recorded "
+                f"range [{seg['start']}, {seg['stop']})"
+            )
+        cursor = seg["stop"]
+        parts_p.append(np.asarray(seg["param"], dtype))
+        parts_w.append(np.asarray(seg["w"], dtype))
+    if cursor != filled:
+        raise ValueError(
+            f"checkpoint {path} segments cover {cursor} kept draws "
+            f"but the manifest records {filled}"
+        )
+    return (
+        np.concatenate(parts_p, axis=-2),
+        np.concatenate(parts_w, axis=-2),
+    )
+
+
+class _SegmentedCheckpoint:
+    """v5 checkpoint state machine: manifest + ordered draw segments.
+
+    On-disk layout (see CKPT_VERSION): ``path`` is the manifest (an
+    atomic npz holding the carried state, counters, identity and the
+    segment range), ``path.segNNNNN.npz`` are the draw segments —
+    indices ``seg_base..seg_base+n_segments-1``, each covering a
+    contiguous filled-iteration range. Every boundary writes
+    (segment, then manifest) — strictly this order, each file
+    atomic-renamed — and NO write ever touches a file the on-disk
+    manifest currently references: appends land at the first index
+    past the manifest's range, and a full rewrite (compaction, the
+    degraded-writer recovery) writes its merged segment at a FRESH
+    index and only then publishes a manifest with the new
+    ``seg_base``. A kill at any instant therefore leaves the
+    previous consistent view or the new one; orphan segments a
+    killed run left beyond the manifest's range are overwritten when
+    a later run claims those indices (and compaction best-effort
+    unlinks the superseded files once the new manifest is on disk).
+
+    Writes run inline (``chunk_pipeline="sync"``) or on the single
+    :class:`BackgroundWriter` thread (``"overlap"``). A background
+    write failure is surfaced as a one-time warning at the next
+    boundary and the checkpointer DEGRADES to synchronous writes,
+    starting with one full rewrite (merged segment 0 + manifest) that
+    re-establishes the on-disk invariants regardless of which
+    background writes were lost.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        meta: np.ndarray,
+        ident: np.ndarray,
+        *,
+        writer: Optional[BackgroundWriter] = None,
+        pstats: Optional[ChunkPipelineStats] = None,
+        full_draws=None,  # callable filled -> (param_np, w_np)
+    ):
+        self.path = path
+        self.meta = meta
+        self.ident = ident
+        self.version = np.asarray([CKPT_VERSION], np.int64)
+        self.writer = writer
+        self.pstats = pstats
+        self._full_draws = full_draws
+        # counters below are touched only by whichever thread executes
+        # the writes (strictly ordered: the writer thread in overlap
+        # mode, the caller in sync/degraded mode — degradation flushes
+        # the writer before the first inline write)
+        self.seg_base = 0
+        self.n_segments = 0
+        self.filled = 0
+        self.degraded = False
+        self._need_full = False
+
+    # ---- raw write paths (run on the writing thread) -------------
+
+    def _write_manifest(self, state_np, it: int) -> int:
+        return save_pytree(
+            self.path,
+            {
+                "state": state_np,
+                "it": np.asarray([it], np.int64),
+                "meta": self.meta,
+                "ident": self.ident,
+                "version": self.version,
+                "seg_base": np.asarray([self.seg_base], np.int64),
+                "n_segments": np.asarray([self.n_segments], np.int64),
+                "filled": np.asarray([self.filled], np.int64),
+            },
+        )
+
+    def _write(self, state_np, seg, it: int) -> None:
+        """One boundary's I/O: optional new segment, then manifest.
+        ``seg`` is None (burn boundary) or (param, w, start, stop)."""
+        t0 = time.perf_counter()
+        nbytes = 0
+        if seg is not None:
+            param, w, start, stop = seg
+            if stop > start:
+                nbytes += save_segment(
+                    self.path, self.seg_base + self.n_segments,
+                    param, w, start, stop,
+                )
+                self.n_segments += 1
+                self.filled = stop
+        nbytes += self._write_manifest(state_np, it)
+        if self.pstats is not None:
+            self.pstats.add_ckpt_write(
+                time.perf_counter() - t0, nbytes
+            )
+
+    def _write_full(self, state_np, param, w, it: int, filled: int):
+        """Full rewrite: ONE merged segment + manifest (compaction
+        and the post-degradation recovery write). The merged segment
+        lands at the first index past the current on-disk range —
+        never on a file the published manifest still references — so
+        a kill between the segment and manifest writes leaves the old
+        view fully intact (the stranded merge file is plain orphan
+        garbage, overwritten by the next full rewrite). Only after
+        the new manifest is on disk are the superseded segment files
+        unlinked (best-effort; stale files are harmless)."""
+        t0 = time.perf_counter()
+        nbytes = 0
+        old = range(self.seg_base, self.seg_base + self.n_segments)
+        new_base = self.seg_base + self.n_segments
+        self.seg_base = new_base
+        self.n_segments = 0
+        self.filled = 0
+        if filled > 0:
+            nbytes += save_segment(
+                self.path, new_base, param, w, 0, filled
+            )
+            self.n_segments = 1
+            self.filled = filled
+        nbytes += self._write_manifest(state_np, it)
+        for i in old:
+            try:
+                os.remove(segment_path(self.path, i))
+            except OSError:  # pragma: no cover - cleanup only
+                pass
+        if self.pstats is not None:
+            self.pstats.add_ckpt_write(
+                time.perf_counter() - t0, nbytes
+            )
+
+    # ---- boundary entry point (caller thread) --------------------
+
+    def _check_degrade(self) -> None:
+        if (
+            self.writer is not None
+            and not self.degraded
+            and self.writer.error is not None
+        ):
+            err = self.writer.error
+            warnings.warn(
+                f"background checkpoint writer failed ({err!r}); "
+                "degrading to synchronous checkpoint writes — the "
+                "next boundary rewrites a full consistent checkpoint, "
+                "then incremental segment writes resume inline",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+            self.writer.flush()  # later jobs were skipped; drain
+            self.degraded = True
+            self._need_full = True
+
+    def save(self, state_src, seg_src, it: int, filled: int) -> None:
+        """Persist one chunk boundary.
+
+        ``state_src``: the carried state — a live device tree (sync)
+        or a :class:`HostSnapshot` (overlap). ``seg_src``: None or
+        (draws_source, start, stop) where draws_source is a live
+        (param, w) slice pair or a HostSnapshot of one.
+        """
+        self._check_degrade()
+
+        def materialize(src):
+            return src.get() if isinstance(src, HostSnapshot) else src
+
+        # materialize on the CALLER thread: in overlap mode this runs
+        # after the chunk's stats confirmed completion, so the async
+        # snapshot copies have already landed and this is a memcpy,
+        # overlapped with the next chunk's device compute — and the
+        # writer thread's measured seconds then cover file I/O only
+        state_np = materialize(state_src)
+        seg = None
+        if seg_src is not None:
+            draws, start, stop = seg_src
+            param, w = materialize(draws)
+            seg = (param, w, start, stop)
+
+        if self.writer is not None and not self.degraded:
+            self.writer.submit(
+                lambda: self._write(state_np, seg, it)
+            )
+            return
+        # inline (sync mode, or degraded overlap)
+        if self._need_full:
+            param, w = self._full_draws(filled)
+            self._write_full(state_np, param, w, it, filled)
+            self._need_full = False
+            return
+        self._write(state_np, seg, it)
+
+    def ensure_synced(self, state_live, it: int, filled: int) -> None:
+        """Drain the background writer; if any write was lost, rewrite
+        a full consistent checkpoint inline from the LIVE state/draws
+        (called on early return — the kill/resume test hook must find
+        the promised checkpoint on disk — and at normal completion)."""
+        if self.writer is None:
+            return
+        self.writer.flush()
+        if self.writer.error is not None and not self.degraded:
+            self._check_degrade()
+        if self._need_full:
+            param, w = self._full_draws(filled)
+            self._write_full(state_live, param, w, it, filled)
+            self._need_full = False
+
+    # ---- resume --------------------------------------------------
+
+    def adopt(self, seg_base: int, n_segments: int, filled: int):
+        """Resume bookkeeping after a successful load."""
+        self.seg_base = seg_base
+        self.n_segments = n_segments
+        self.filled = filled
+
+    def compact(self, state_np, param, w, it: int, filled: int):
+        """Merge all segments into one (resume-time compaction: keeps
+        the per-run segment count bounded across kill/resume cycles).
+        Call adopt() first so the merge lands past the on-disk range
+        (_write_full) — the manifest is the only source of truth for
+        which segments exist, so the superseded files it unlinks (and
+        any orphans a kill strands) can never be misread."""
+        self._write_full(state_np, param, w, it, filled)
+
+
 def fit_subsets_chunked(
     model: SpatialGPSampler,
     part: Partition,
@@ -239,6 +561,7 @@ def fit_subsets_chunked(
     progress=None,
     stop_after_chunks: Optional[int] = None,
     nan_guard: bool = False,
+    pipeline_stats: Optional[ChunkPipelineStats] = None,
 ) -> Optional[SubsetResult]:
     """Unified chunked K-subset executor: the whole MCMC (burn-in AND
     sampling) runs as a host loop of ``chunk_iters``-long compiled
@@ -250,25 +573,47 @@ def fit_subsets_chunked(
       the share-nothing SMK property, SURVEY.md §2.2/§5.8);
     - ``chunk_size``: lax.map over K-chunks inside each dispatch to
       bound resident memory (same lever as fit_subsets_vmap);
-    - ``checkpoint_path``: atomic .npz checkpoint after every chunk
-      (including burn-in chunks — format v3 carries the global
-      iteration counter); an interrupted call resumes bit-exactly
-      (the PRNG sequence lives in the carried state);
+    - ``checkpoint_path``: checkpoint after every chunk (including
+      burn-in chunks); format v5 writes a manifest (carried state +
+      counters, O(1) in the iteration count) plus ONE incremental
+      draw segment per sampling chunk (O(chunk) bytes — see
+      :class:`_SegmentedCheckpoint`), every file atomic-renamed; an
+      interrupted call resumes bit-exactly (the PRNG sequence lives
+      in the carried state);
     - ``progress``: callback(dict) after every chunk — the n.report
       parity hook (the reference prints acceptance every 10 batches,
       MetaKriging_BinaryResponse.R:84); receives phase, iteration,
-      n_samples and the running phi acceptance rate.
+      n_samples and the running phi acceptance rate. A callback that
+      raises is caught and warned about ONCE, and the run keeps
+      sampling; raise a :class:`ProgressAbort` subclass to abort
+      deliberately.
 
     - ``nan_guard``: after every chunk, check the carried state's
       small leaves for NaN/inf per subset and raise
       :class:`SubsetNaNError` (naming the shards, BEFORE the save —
       the last checkpoint stays finite/resumable) instead of silently
       burning the rest of a multi-hour run. One tiny on-device reduce
-      + host fetch per chunk; the post-hoc net is find_failed_subsets.
+      + host fetch per chunk (``_chunk_stats`` — the guard/report
+      fetches never touch the full carried state); the post-hoc net
+      is find_failed_subsets.
 
     ``stop_after_chunks`` ends the run early after that many chunks
     (burn or sampling), returning None with the checkpoint on disk —
     the kill-and-resume test hook.
+
+    ``model.config.chunk_pipeline`` selects the host loop. ``"sync"``
+    (default) is the historical serial loop: dispatch, block on
+    guard/report, write the checkpoint, dispatch again. ``"overlap"``
+    snapshots chunk t's outputs with async device-to-host copies and
+    dispatches chunk t+1 BEFORE any host work, so guard/report/
+    checkpoint for chunk t execute while the device computes t+1, and
+    checkpoint I/O runs on a background writer thread (degrading to
+    synchronous writes on failure). Both modes dispatch the SAME
+    compiled chunk programs in the same order, so final draws are
+    BIT-IDENTICAL across modes (tests/test_chunk_pipeline.py);
+    "sync" remains bit-identical to the historical loop. Pass a
+    ``pipeline_stats`` (utils/tracing.ChunkPipelineStats) to collect
+    per-chunk dispatch/stall/D2H/checkpoint metrics either way.
     """
     cfg = model.config
     if chunk_iters < 1:
@@ -362,20 +707,40 @@ def fit_subsets_chunked(
         np.int64,
     )
     ident = _run_identity(cfg, key, data, beta_init)
-    version = np.asarray([CKPT_VERSION], np.int64)
-    # shape-only template leaves for the draws too — materializing the
-    # full-capacity accumulators just to carry the treedef would spike
-    # device memory by exactly the buffers the donation work trims
-    draws_like = jax.eval_shape(empty_draws)
     like = {
         "state": init_like,
-        "param_draws": draws_like[0],
-        "w_draws": draws_like[1],
         "it": np.asarray([0], np.int64),
         "meta": meta,
         "ident": ident,
-        "version": version,
+        "version": np.asarray([CKPT_VERSION], np.int64),
+        "seg_base": np.asarray([0], np.int64),
+        "n_segments": np.asarray([0], np.int64),
+        "filled": np.asarray([0], np.int64),
     }
+
+    mode = cfg.chunk_pipeline
+    pstats = pipeline_stats
+    if pstats is not None:
+        pstats.mode = mode
+
+    writer = (
+        BackgroundWriter()
+        if (mode == "overlap" and checkpoint_path is not None)
+        else None
+    )
+    ck = None
+    if checkpoint_path is not None:
+        ck = _SegmentedCheckpoint(
+            checkpoint_path, meta, ident,
+            writer=writer, pstats=pstats,
+            # live-accumulator access for the degraded/compaction
+            # full rewrite: regions beyond `filled` are never read,
+            # so later in-flight chunk writes can't corrupt the slice
+            full_draws=lambda filled: (
+                np.asarray(param_draws[..., :filled, :]),
+                np.asarray(w_draws[..., :filled, :]),
+            ),
+        )
 
     if checkpoint_path is not None and os.path.exists(checkpoint_path):
         try:
@@ -387,7 +752,10 @@ def fit_subsets_chunked(
                 f"checkpoint {checkpoint_path} does not match the "
                 f"current checkpoint format v{CKPT_VERSION} (v2 added "
                 "run-identity stamping, v3 the iteration counter, v4 "
-                "the n_chains meta + sampled identity) — "
+                "the n_chains meta + sampled identity, v5 the "
+                "incremental draw-segment layout: the file is now a "
+                "manifest and kept draws live in sidecar "
+                "<path>.segNNNNN.npz files) — "
                 "it was written by an older build or for a different "
                 "run shape; delete the file or pass a fresh "
                 "checkpoint_path"
@@ -413,9 +781,32 @@ def fit_subsets_chunked(
             )
         # leaves arrive as numpy (PRNG keys re-wrapped by load_pytree)
         state = ckpt["state"]
-        param_draws = to_capacity(jnp.asarray(ckpt["param_draws"], dtype))
-        w_draws = to_capacity(jnp.asarray(ckpt["w_draws"], dtype))
         it = int(np.asarray(ckpt["it"])[0])
+        seg_base = int(np.asarray(ckpt["seg_base"])[0])
+        n_seg = int(np.asarray(ckpt["n_segments"])[0])
+        filled = int(np.asarray(ckpt["filled"])[0])
+        if filled != max(0, it - cfg.n_burn_in):
+            raise ValueError(
+                f"checkpoint {checkpoint_path} is inconsistent: "
+                f"manifest covers {filled} kept draws but the "
+                f"iteration counter {it} implies "
+                f"{max(0, it - cfg.n_burn_in)}"
+            )
+        param_np, w_np = _read_segments(
+            checkpoint_path, seg_base, n_seg, filled, dtype
+        )
+        if filled > 0:
+            param_draws = to_capacity(jnp.asarray(param_np, dtype))
+            w_draws = to_capacity(jnp.asarray(w_np, dtype))
+        else:
+            param_draws, w_draws = empty_draws()
+        ck.adopt(seg_base, n_seg, filled)
+        if n_seg > 1:
+            # resume-time compaction: merge the per-chunk segments
+            # into one so the file count stays bounded across
+            # kill/resume cycles (one ordered O(filled) rewrite to a
+            # fresh index — crash-safe, see _write_full)
+            ck.compact(state, param_np, w_np, it, filled)
         if put is not None:
             state = put(state)
             param_draws = put(param_draws)
@@ -424,27 +815,6 @@ def fit_subsets_chunked(
         state = _init_states(model, keys, data, beta_init)
         param_draws, w_draws = empty_draws()
         it = 0
-
-    def save():
-        if checkpoint_path is None:
-            return
-        # checkpoint only the FILLED draws region — the capacity tail
-        # is zeros by construction, so serializing it would price every
-        # burn-in checkpoint at the full end-of-run size; to_capacity
-        # pads the accumulators back on load
-        filled = max(0, it - cfg.n_burn_in)
-        save_pytree(
-            checkpoint_path,
-            {
-                "state": state,
-                "param_draws": param_draws[..., :filled, :],
-                "w_draws": w_draws[..., :filled, :],
-                "it": np.asarray([it], np.int64),
-                "meta": meta,
-                "ident": ident,
-                "version": version,
-            },
-        )
 
     chunk_fns = {}
 
@@ -455,81 +825,226 @@ def fit_subsets_chunked(
             )
         return chunk_fns[kind, n]
 
-    def report(phase, window_start):
+    n_burn = cfg.n_burn_in
+    want_stats = nan_guard or progress is not None
+    warned_progress = [False]
+
+    def call_progress(info):
         if progress is None:
             return
+        try:
+            progress(info)
+        except ProgressAbort:
+            raise
+        except Exception as e:
+            # a broken user logging hook must not kill a multi-hour
+            # fan-out — warn once, keep sampling (regression test:
+            # tests/test_chunk_pipeline.py)
+            if not warned_progress[0]:
+                warned_progress[0] = True
+                warnings.warn(
+                    f"progress callback raised {e!r}; the run "
+                    "continues (this warning is emitted once — raise "
+                    "a ProgressAbort subclass from the callback to "
+                    "abort deliberately)",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+
+    def report(phase, it_end, window_start, accept_mean):
         pe = cfg.phi_update_every
         # phi updates land on global iterations i = 0 (mod pe); the
-        # accept counter covers [window_start, it) — the window since
-        # it was last zeroed (0 during burn-in, n_burn_in during
+        # accept counter covers [window_start, it_end) — the window
+        # since it was last zeroed (0 during burn-in, n_burn_in during
         # sampling) — so the rate divides by the updates in THAT
         # window, not by ceil(it/pe) over the whole run
-        n_updates = max(1, -(-it // pe) - -(-window_start // pe))
-        progress({
+        n_updates = max(
+            1, -(-it_end // pe) - -(-window_start // pe)
+        )
+        call_progress({
             "phase": phase,
-            "iteration": it,
+            "iteration": it_end,
             "n_samples": cfg.n_samples,
-            "phi_accept_rate": float(
-                np.mean(np.asarray(state.phi_accept)) / n_updates
-            ),
+            "phi_accept_rate": float(accept_mean) / n_updates,
         })
 
-    def guard():
-        if not nan_guard:
-            return
-        ok = np.asarray(_finite_subsets(state))
-        if not ok.all():
-            raise SubsetNaNError(np.where(~ok)[0], it)
+    # The chunk schedule is fully determined by (it, chunk_iters):
+    # both pipeline modes execute exactly this plan, so the compiled
+    # programs and their dispatch order — the only things the chain's
+    # bits depend on — are identical across modes.
+    plan = []
+    it_plan = it
+    while it_plan < n_burn:
+        n = min(chunk_iters, n_burn - it_plan)
+        plan.append(("burn", it_plan, n))
+        it_plan += n
+    while it_plan < cfg.n_samples:
+        n = min(chunk_iters, cfg.n_samples - it_plan)
+        plan.append(("samp", it_plan, n))
+        it_plan += n
+    truncated = False
+    if stop_after_chunks is not None and stop_after_chunks < len(plan):
+        plan = plan[:stop_after_chunks]
+        truncated = True
 
-    chunks_done = 0
-    n_burn = cfg.n_burn_in
-    while it < n_burn:
-        n = min(chunk_iters, n_burn - it)
-        state = chunk_fn("burn", n)(data, state, jnp.asarray(it))
-        it += n
-        guard()
-        # report before the boundary reset so the last burn line
-        # carries the full burn-in acceptance, not 0.0
-        report("burn", 0)
-        if it == n_burn:
-            # post-burn-in acceptance accounting, as burn_in() does
+    stats_bytes = k + 4  # (K,) bool + one f32 scalar per boundary
+    t_loop0 = time.perf_counter()
+
+    def dispatch(kind, start, n):
+        """Issue one chunk's device work; returns the new carry."""
+        nonlocal state, param_draws, w_draws, it
+        if kind == "burn":
+            state = chunk_fn("burn", n)(data, state, jnp.asarray(start))
+        else:
+            state, (pd, wd) = chunk_fn("samp", n)(
+                data, state, jnp.asarray(start)
+            )
+            # draws land at [start - n_burn, start - n_burn + n) on
+            # the iteration axis of the PREALLOCATED accumulators —
+            # axis 1 for a single chain (K, kept, d), axis 2 with
+            # chains (K, C, kept, d) — with the old buffer DONATED
+            # into the same-shaped update output on donation-capable
+            # backends (executor.write_draws; shape-matching is what
+            # makes the donation actually alias, unlike a growing
+            # concat).
+            param_draws = write_draws(param_draws, pd, start - n_burn)
+            w_draws = write_draws(w_draws, wd, start - n_burn)
+        it = start + n
+
+    def boundary_host_work(b, stall):
+        """Guard + report + checkpoint for one completed chunk.
+
+        ``b`` is the boundary record captured at dispatch time. In
+        "sync" mode this runs with the device idle (stall=True); in
+        "overlap" mode it runs while the device computes the next
+        chunk (stall=False except for the final chunk), blocking only
+        on chunk b's own tiny stats — which are ready the moment the
+        chunk finishes.
+        """
+        t0 = time.perf_counter()
+        if b["stats"] is not None:
+            finite = np.asarray(b["stats"][0])
+            accept = float(np.asarray(b["stats"][1]))
+            if nan_guard and not finite.all():
+                if ck is not None and writer is not None:
+                    # earlier checkpoints must land before the raise:
+                    # the error's contract is "the last checkpoint
+                    # precedes the failure"
+                    writer.flush()
+                raise SubsetNaNError(np.where(~finite)[0], b["it"])
+            report(b["phase"], b["it"], b["window_start"], accept)
+        if ck is not None:
+            ck.save(
+                b["state_src"], b["seg_src"], b["it"], b["filled"]
+            )
+        host_s = time.perf_counter() - t0
+        if pstats is not None:
+            pstats.record_chunk(
+                chunk=b["index"], phase=b["phase"], n_iters=b["n"],
+                iteration=b["it"], dispatch_s=b["dispatch_s"],
+                host_work_s=host_s,
+                host_stall_s=host_s if stall else 0.0,
+                d2h_bytes=b["d2h_bytes"],
+            )
+
+    def boundary_record(index, kind, start, n, dispatch_s):
+        """Capture everything chunk (start, n)'s host work needs,
+        snapshotting device outputs so the later (possibly
+        background) consumption is donation-safe."""
+        nonlocal state
+        it_end = start + n
+        phase = "burn" if kind == "burn" else "sample"
+        stats = _chunk_stats(state) if want_stats else None
+        if stats is not None and mode == "overlap":
+            for leaf in stats:
+                start_copy = getattr(leaf, "copy_to_host_async", None)
+                if start_copy is not None:
+                    start_copy()
+        if kind == "burn" and it_end == n_burn:
+            # post-burn-in acceptance accounting, as burn_in() does —
+            # BEFORE the checkpoint snapshot (the saved boundary state
+            # is the reset one, matching the historical loop), AFTER
+            # the stats dispatch (the last burn report carries the
+            # full burn-in acceptance, not 0.0)
             state = state._replace(
                 phi_accept=jnp.zeros_like(state.phi_accept)
             )
-        save()
-        chunks_done += 1
-        if (
-            stop_after_chunks is not None
-            and chunks_done >= stop_after_chunks
-            and it < cfg.n_samples
-        ):
-            return None
+        filled = max(0, it_end - n_burn)
+        state_src = seg_src = None
+        d2h = stats_bytes if stats is not None else 0
+        if ck is not None:
+            if mode == "overlap":
+                state_src = HostSnapshot(state)
+                d2h += state_src.nbytes
+            else:
+                state_src = state
+                d2h += tree_nbytes(state)
+            if kind == "samp":
+                a, b_ = start - n_burn, filled
+                sl_p = param_draws[..., a:b_, :]
+                sl_w = w_draws[..., a:b_, :]
+                if mode == "overlap":
+                    draws = HostSnapshot((sl_p, sl_w))
+                    d2h += draws.nbytes
+                else:
+                    draws = (sl_p, sl_w)
+                    d2h += tree_nbytes(draws)
+                seg_src = (draws, a, b_)
+        return {
+            "index": index, "phase": phase, "n": n, "it": it_end,
+            "window_start": 0 if kind == "burn" else n_burn,
+            "stats": stats, "state_src": state_src,
+            "seg_src": seg_src, "filled": filled,
+            "dispatch_s": dispatch_s, "d2h_bytes": d2h,
+        }
 
-    while it < cfg.n_samples:
-        n = min(chunk_iters, cfg.n_samples - it)
-        state, (pd, wd) = chunk_fn("samp", n)(
-            data, state, jnp.asarray(it)
-        )
-        # draws land at [it - n_burn, it - n_burn + n) on the
-        # iteration axis of the PREALLOCATED accumulators — axis 1
-        # for a single chain (K, kept, d), axis 2 with chains
-        # (K, C, kept, d) — with the old buffer DONATED into the
-        # same-shaped update output on donation-capable backends
-        # (executor.write_draws; shape-matching is what makes the
-        # donation actually alias, unlike a growing concat).
-        param_draws = write_draws(param_draws, pd, it - n_burn)
-        w_draws = write_draws(w_draws, wd, it - n_burn)
-        it += n
-        guard()
-        report("sample", n_burn)
-        save()
-        chunks_done += 1
-        if (
-            stop_after_chunks is not None
-            and chunks_done >= stop_after_chunks
-            and it < cfg.n_samples
-        ):
-            return None
+    try:
+        if mode == "overlap":
+            pending = None
+            for index, (kind, start, n) in enumerate(plan):
+                t0 = time.perf_counter()
+                dispatch(kind, start, n)
+                b = boundary_record(
+                    index, kind, start, n,
+                    time.perf_counter() - t0,
+                )
+                # chunk index's work is now queued on the device;
+                # the PREVIOUS chunk's host work overlaps it
+                if pending is not None:
+                    boundary_host_work(pending, stall=False)
+                pending = b
+            if pending is not None:
+                # terminal drain: no next chunk in flight, so this
+                # host work is genuine stall
+                boundary_host_work(pending, stall=True)
+            if ck is not None:
+                t0 = time.perf_counter()
+                ck.ensure_synced(state, it, max(0, it - n_burn))
+                if pstats is not None:
+                    pstats.record_chunk(
+                        chunk=len(plan), phase="drain", n_iters=0,
+                        iteration=it, dispatch_s=0.0,
+                        host_work_s=time.perf_counter() - t0,
+                        host_stall_s=time.perf_counter() - t0,
+                        d2h_bytes=0,
+                    )
+        else:
+            for index, (kind, start, n) in enumerate(plan):
+                t0 = time.perf_counter()
+                dispatch(kind, start, n)
+                b = boundary_record(
+                    index, kind, start, n,
+                    time.perf_counter() - t0,
+                )
+                boundary_host_work(b, stall=True)
+    finally:
+        if writer is not None:
+            writer.close()
+        if pstats is not None:
+            pstats.total_wall_s = time.perf_counter() - t_loop0
+
+    if truncated and it < cfg.n_samples:
+        return None
 
     finalize = jax.jit(jax.vmap(model.finalize))
     return finalize(state, param_draws, w_draws)
@@ -550,6 +1065,7 @@ def fit_subsets_checkpointed(
     chunk_size: Optional[int] = None,
     progress=None,
     nan_guard: bool = False,
+    pipeline_stats: Optional[ChunkPipelineStats] = None,
 ) -> Optional[SubsetResult]:
     """K-subset fan-out with periodic checkpointing and resume — the
     checkpoint-requiring entry point over ``fit_subsets_chunked`` (see
@@ -563,6 +1079,7 @@ def fit_subsets_checkpointed(
         progress=progress,
         stop_after_chunks=stop_after_chunks,
         nan_guard=nan_guard,
+        pipeline_stats=pipeline_stats,
     )
 
 

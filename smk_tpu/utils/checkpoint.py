@@ -7,13 +7,25 @@ grids) round-trips through a single .npz file: fields are flattened
 with their treedef recorded, so resume = load + continue the scan, and
 a failed shard is recoverable by re-running just that subset (the fit
 is a pure function of (data slice, key)).
+
+Since checkpoint format v5 (parallel/recovery.py) the chunked
+executor's draws no longer ride in the manifest: each chunk boundary
+appends one SEGMENT file holding only that chunk's new kept draws
+(:func:`save_segment` / :func:`load_segment`), so per-boundary I/O is
+O(chunk) instead of O(iterations so far). :class:`BackgroundWriter`
+executes those writes on a single background thread in strict
+submission order — the ``chunk_pipeline="overlap"`` mode's
+checkpoint-off-the-critical-path half (the other half is the async
+device-to-host snapshot, parallel/executor.py).
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Any
+import queue
+import threading
+from typing import Any, Callable, Optional
 
 import jax
 import numpy as np
@@ -24,8 +36,9 @@ def _is_key(leaf: Any) -> bool:
     return dt is not None and jax.dtypes.issubdtype(dt, jax.dtypes.prng_key)
 
 
-def save_pytree(path: str, tree: Any) -> None:
-    """Save an arbitrary array pytree to ``path`` (.npz).
+def save_pytree(path: str, tree: Any) -> int:
+    """Save an arbitrary array pytree to ``path`` (.npz); returns the
+    bytes written.
 
     Typed PRNG key arrays (part of SamplerState) are stored via their
     raw key data and re-wrapped on load.
@@ -40,10 +53,131 @@ def save_pytree(path: str, tree: Any) -> None:
     arrays["__treedef__"] = np.frombuffer(
         json.dumps(str(treedef)).encode(), dtype=np.uint8
     )
+    return _atomic_savez(path, arrays)
+
+
+def _atomic_savez(path: str, arrays: dict) -> int:
+    """np.savez ``arrays`` to ``path`` via write-to-temp +
+    atomic-rename (the same crash-ordering contract as save_pytree);
+    returns the bytes written."""
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         np.savez(f, **arrays)
+    size = os.path.getsize(tmp)
     os.replace(tmp, path)
+    return size
+
+
+def segment_path(path: str, index: int) -> str:
+    """On-disk name of draw segment ``index`` of the v5 checkpoint at
+    ``path`` (the manifest). Deterministic so a resumed run OVERWRITES
+    any orphan segment a killed predecessor left at the same index —
+    the manifest is always written after its segments, so it never
+    references stale content."""
+    return f"{path}.seg{index:05d}.npz"
+
+
+def save_segment(
+    path: str,
+    index: int,
+    param_draws: np.ndarray,
+    w_draws: np.ndarray,
+    start: int,
+    stop: int,
+) -> int:
+    """Write one v5 draw segment: the kept-draw slices covering filled
+    iterations [start, stop). Atomic; returns bytes written."""
+    return _atomic_savez(
+        segment_path(path, index),
+        {
+            "param": np.asarray(param_draws),
+            "w": np.asarray(w_draws),
+            "start": np.asarray([start], np.int64),
+            "stop": np.asarray([stop], np.int64),
+        },
+    )
+
+
+def load_segment(path: str, index: int) -> dict:
+    """Read one v5 draw segment written by :func:`save_segment`."""
+    seg = segment_path(path, index)
+    with np.load(seg) as data:
+        return {
+            "param": data["param"],
+            "w": data["w"],
+            "start": int(data["start"][0]),
+            "stop": int(data["stop"][0]),
+        }
+
+
+class BackgroundWriter:
+    """Single background thread executing write jobs strictly in
+    submission order.
+
+    The overlap chunk pipeline enqueues each boundary's segment +
+    manifest write here so the host loop returns to dispatching
+    immediately; ordering is preserved (one thread, FIFO queue) and
+    every individual write keeps the atomic-rename contract, so a kill
+    at any instant leaves either the previous manifest or the new one
+    — never a torn file. A failed job records its exception and all
+    LATER jobs are skipped (executing job t+1 after job t failed could
+    publish a manifest whose segment never landed); the caller
+    observes ``error`` at the next chunk boundary and degrades to
+    synchronous writes (parallel/recovery.py).
+    """
+
+    def __init__(self, name: str = "smk-ckpt-writer"):
+        self._q: queue.Queue = queue.Queue()
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._loop, name=name, daemon=True
+        )
+        self._started = False
+        self._closed = False
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        """First exception raised by a job, or None. Stays set: a
+        writer that failed once never executes another job."""
+        return self._error
+
+    def submit(self, job: Callable[[], None]) -> None:
+        """Enqueue ``job`` for ordered background execution."""
+        if self._closed:
+            raise RuntimeError("BackgroundWriter is closed")
+        if not self._started:
+            self._thread.start()
+            self._started = True
+        self._q.put(job)
+
+    def flush(self) -> None:
+        """Block until every submitted job has executed (or been
+        skipped after an error). Does not raise — check ``error``."""
+        if self._started:
+            self._q.join()
+
+    def close(self) -> None:
+        """Flush and stop the thread. Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._started:
+            self._q.join()
+            self._q.put(None)
+            self._thread.join()
+
+    def _loop(self) -> None:
+        while True:
+            job = self._q.get()
+            if job is None:
+                break
+            try:
+                if self._error is None:
+                    job()
+            except BaseException as e:  # surfaced at next boundary
+                self._error = e
+            finally:
+                self._q.task_done()
 
 
 def load_pytree(path: str, like: Any) -> Any:

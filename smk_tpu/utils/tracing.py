@@ -9,9 +9,10 @@ profiles (SURVEY.md §5.1).
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, Iterator
+from typing import Any, Dict, Iterator, List
 
 import jax
 import numpy as np
@@ -77,6 +78,85 @@ class PhaseTimes:
 
     def as_dict(self) -> Dict[str, float]:
         return dict(self.seconds)
+
+
+@dataclass
+class ChunkPipelineStats:
+    """Per-chunk observability for the chunked executor's host loop
+    (parallel/recovery.py fit_subsets_chunked, both ``chunk_pipeline``
+    modes).
+
+    One ``record_chunk`` entry per compiled chunk dispatch:
+
+    - ``dispatch_s``: wall seconds the host spent issuing the chunk's
+      device work (dispatch + async snapshot starts — should be
+      milliseconds; a large value means tracing/compile on the hot
+      path).
+    - ``host_stall_s``: wall seconds of host-side work during which
+      the DEVICE had no queued chunk — guard/report fetches and
+      checkpoint writes in "sync" mode (the whole point of the overlap
+      pipeline is to drive this to ~0 for all but the final chunk),
+      plus the terminal drain in "overlap" mode.
+    - ``host_work_s``: total guard/report/checkpoint host seconds for
+      the chunk, whether or not they overlapped device compute.
+    - ``d2h_bytes``: bytes snapshotted device→host for this chunk
+      (stats scalars + carried-state snapshot + new draw slices).
+
+    Checkpoint-write accounting (``add_ckpt_write``) is thread-safe:
+    the overlap mode's background writer reports its wall seconds and
+    bytes from the writer thread. ``ckpt_boundary_bytes`` keeps the
+    per-boundary byte counts so the incremental-segment claim —
+    per-boundary bytes O(chunk), flat in the iteration counter — is
+    directly measurable (scripts/async_pipe_probe.py,
+    ASYNC_PIPE_*.jsonl).
+    """
+
+    mode: str = "sync"
+    chunks: List[Dict[str, Any]] = field(default_factory=list)
+    ckpt_write_s: float = 0.0
+    ckpt_bytes: int = 0
+    ckpt_boundary_bytes: List[int] = field(default_factory=list)
+    total_wall_s: float = 0.0
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False
+    )
+
+    def record_chunk(self, **entry: Any) -> None:
+        self.chunks.append(entry)
+
+    def add_ckpt_write(self, seconds: float, nbytes: int) -> None:
+        with self._lock:
+            self.ckpt_write_s += float(seconds)
+            self.ckpt_bytes += int(nbytes)
+            self.ckpt_boundary_bytes.append(int(nbytes))
+
+    def aggregate(self) -> Dict[str, Any]:
+        """The bench-record / protocol summary."""
+        stall = sum(c.get("host_stall_s", 0.0) for c in self.chunks)
+        work = sum(c.get("host_work_s", 0.0) for c in self.chunks)
+        disp = sum(c.get("dispatch_s", 0.0) for c in self.chunks)
+        d2h = sum(int(c.get("d2h_bytes", 0)) for c in self.chunks)
+        wall = self.total_wall_s
+        return {
+            "mode": self.mode,
+            "n_chunks": len(self.chunks),
+            "total_wall_s": round(wall, 4),
+            "dispatch_s": round(disp, 4),
+            "host_work_s": round(work, 4),
+            "host_stall_s": round(stall, 4),
+            "host_stall_frac": (
+                round(stall / wall, 4) if wall > 0 else 0.0
+            ),
+            "d2h_bytes": d2h,
+            "ckpt_write_s": round(self.ckpt_write_s, 4),
+            "ckpt_bytes": self.ckpt_bytes,
+            "ckpt_boundary_bytes": list(self.ckpt_boundary_bytes),
+            # fraction of the wall during which the device had work
+            # queued — the whole-chip efficiency headline
+            "overlap_efficiency": (
+                round(1.0 - stall / wall, 4) if wall > 0 else 1.0
+            ),
+        }
 
 
 @contextlib.contextmanager

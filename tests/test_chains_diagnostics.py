@@ -129,6 +129,7 @@ class TestDiagnosticFieldsSingleChain:
         assert res.latent_ess_per_sec > 0
 
 
+@pytest.mark.slow  # r8 gate window rebudget (ROADMAP 870 s, rc=0)
 class TestMultiChain:
     def test_chain_keys_layout(self):
         k1 = subset_chain_keys(jax.random.key(0), 4, 1)

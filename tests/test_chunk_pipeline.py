@@ -1,0 +1,403 @@
+"""Overlapped chunk pipeline tests (ISSUE 5): sync-vs-overlap draw
+bit-identity, the v5 segmented checkpoint (kill/resume through the
+background writer, v4 rejection, orphan-segment overwrite, degraded
+synchronous fallback), device-side guard parity, and the hardened
+progress callback.
+
+Sizes are deliberately tiny (m=16, dozens of iterations): each
+fit_subsets_chunked call recompiles its chunk programs, and this file
+is NOT grandfathered by the conftest slow gate — every unmarked test
+must clear the per-test budget. The scale-bearing A/B evidence lives
+in scripts/async_pipe_probe.py (ASYNC_PIPE_r08.jsonl) and the bench
+chunk_pipeline_ab cell, not here.
+"""
+
+import dataclasses
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from smk_tpu.config import SMKConfig
+from smk_tpu.models.probit_gp import SpatialProbitGP
+from smk_tpu.parallel.partition import random_partition
+from smk_tpu.parallel.recovery import (
+    ProgressAbort,
+    SubsetNaNError,
+    _chunk_stats,
+    _finite_subsets,
+    fit_subsets_chunked,
+)
+from smk_tpu.utils.checkpoint import (
+    BackgroundWriter,
+    load_segment,
+    save_pytree,
+    save_segment,
+    segment_path,
+)
+from smk_tpu.utils.tracing import ChunkPipelineStats
+
+CFG = SMKConfig(
+    n_subsets=4, n_samples=24, burn_in_frac=0.5, phi_update_every=2
+)
+
+
+@pytest.fixture(scope="module")
+def problem():
+    rng = np.random.default_rng(7)
+    n, q, p, t = 64, 1, 2, 3
+    coords = jnp.asarray(rng.uniform(size=(n, 2)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(n, q, p)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, size=(n, q)), jnp.float32)
+    ct = jnp.asarray(rng.uniform(size=(t, 2)), jnp.float32)
+    xt = jnp.asarray(rng.normal(size=(t, q, p)), jnp.float32)
+    part = random_partition(jax.random.key(0), y, x, coords, 4)
+    return part, ct, xt, jax.random.key(1)
+
+
+def run(problem, mode, path=None, cfg=CFG, chunk_iters_=6, **kw):
+    part, ct, xt, key = problem
+    model = SpatialProbitGP(
+        dataclasses.replace(cfg, chunk_pipeline=mode), weight=1
+    )
+    return fit_subsets_chunked(
+        model, part, ct, xt, key,
+        chunk_iters=chunk_iters_, checkpoint_path=path, **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def sync_ref(problem, tmp_path_factory):
+    """The sync-mode reference result (with a checkpoint, so the
+    manifest/segment layout is also the comparison baseline)."""
+    path = str(tmp_path_factory.mktemp("ref") / "ref.npz")
+    res = run(problem, "sync", path)
+    return res, path
+
+
+class TestSyncOverlapParity:
+    def test_overlap_bitwise_identical_and_kill_resume(
+        self, problem, sync_ref, tmp_path
+    ):
+        """The tentpole contract, end to end: (1) "overlap" produces
+        BIT-identical final draws to "sync" (both modes dispatch the
+        same compiled chunk programs in the same order — the pipeline
+        only moves host work); (2) a run killed mid-flight under the
+        background writer resumes bit-exactly, even when the killed
+        run left an orphan segment beyond the manifest's count (the
+        crash window between a segment landing and its manifest: the
+        resumed run must overwrite, not trip over, the orphan)."""
+        ref, _ = sync_ref
+        pstats = ChunkPipelineStats()
+        res_ov = run(
+            problem, "overlap", str(tmp_path / "ov.npz"),
+            pipeline_stats=pstats,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ref.param_samples),
+            np.asarray(res_ov.param_samples),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ref.w_samples), np.asarray(res_ov.w_samples)
+        )
+        # observability: one record per chunk (4 chunks of 6 = 24
+        # iterations) + the terminal drain record
+        agg = pstats.aggregate()
+        assert agg["mode"] == "overlap"
+        assert agg["n_chunks"] == 5
+        assert agg["d2h_bytes"] > 0
+        # ... and per-boundary checkpoint bytes recorded per write
+        assert len(agg["ckpt_boundary_bytes"]) == 4
+
+        # kill/resume through the background writer
+        path = str(tmp_path / "kill.npz")
+        partial = run(
+            problem, "overlap", path, stop_after_chunks=2
+        )
+        assert partial is None
+        # simulate the crash residue: a garbage orphan segment at the
+        # next index, not referenced by the manifest
+        with open(segment_path(path, 1), "wb") as f:
+            f.write(b"not an npz")
+        res_resumed = run(problem, "overlap", path)
+        np.testing.assert_array_equal(
+            np.asarray(ref.param_samples),
+            np.asarray(res_resumed.param_samples),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ref.w_samples),
+            np.asarray(res_resumed.w_samples),
+        )
+
+    def test_v4_checkpoint_rejected_with_v5_message(
+        self, problem, sync_ref, tmp_path
+    ):
+        """A v4-layout file (draws inline, no segment counters) must
+        be rejected with the message naming the v5 segment layout —
+        not a generic pytree mismatch."""
+        ref, ref_path = sync_ref
+        # a faithful v4 structure: the draws arrays ride in the file
+        path = str(tmp_path / "v4.npz")
+        save_pytree(path, {
+            "state": {"beta": np.zeros((4, 2), np.float32)},
+            "param_draws": np.zeros((4, 12, 4), np.float32),
+            "w_draws": np.zeros((4, 12, 3), np.float32),
+            "it": np.asarray([12], np.int64),
+            "meta": np.zeros(6, np.int64),
+            "ident": np.zeros(4, np.uint32),
+            "version": np.asarray([4], np.int64),
+        })
+        with pytest.raises(ValueError, match="segNNNNN"):
+            run(problem, "sync", path)
+
+    def test_compaction_crash_window_is_safe(self, problem, tmp_path):
+        """Resume-time compaction merges N>1 segments — its merged
+        segment must land at a FRESH index, so a kill between that
+        write and the manifest leaves the OLD view fully readable (a
+        stranded merge file at the target index is orphan garbage the
+        re-run compaction overwrites), and the superseded per-chunk
+        files are unlinked once the new manifest is on disk."""
+        ref = run(problem, "sync", chunk_iters_=4)
+        path = str(tmp_path / "c.npz")
+        assert run(
+            problem, "overlap", path, chunk_iters_=4,
+            stop_after_chunks=5,
+        ) is None  # 3 burn + 2 sampling chunks -> segments 0 and 1
+        assert os.path.exists(segment_path(path, 1))
+        # simulate a kill mid-compaction: the merge targets index 2
+        with open(segment_path(path, 2), "wb") as f:
+            f.write(b"stranded partial merge")
+        res = run(problem, "overlap", path, chunk_iters_=4)
+        np.testing.assert_array_equal(
+            np.asarray(ref.param_samples), np.asarray(res.param_samples)
+        )
+        # compacted: merged segment at index 2, old files gone
+        assert os.path.exists(segment_path(path, 2))
+        assert not os.path.exists(segment_path(path, 0))
+        assert not os.path.exists(segment_path(path, 1))
+
+    def test_resume_is_mode_agnostic(self, problem, sync_ref, tmp_path):
+        """chunk_pipeline is normalized out of the run-identity hash:
+        a checkpoint written under "overlap" resumes under "sync"
+        (the operational escape hatch) — bit-identically."""
+        ref, _ = sync_ref
+        path = str(tmp_path / "x.npz")
+        assert run(
+            problem, "overlap", path, stop_after_chunks=3
+        ) is None
+        res = run(problem, "sync", path)
+        np.testing.assert_array_equal(
+            np.asarray(ref.param_samples),
+            np.asarray(res.param_samples),
+        )
+
+
+class TestDeviceGuard:
+    def test_chunk_stats_matches_finite_subsets(self, problem):
+        """The fused device-side stats program returns EXACTLY the
+        host-side _finite_subsets vector (the guard's contract) plus
+        the acceptance-mean scalar."""
+        from smk_tpu.parallel.executor import (
+            init_subset_states,
+            stacked_subset_data,
+        )
+
+        part, ct, xt, key = problem
+        model = SpatialProbitGP(CFG, weight=1)
+        data = stacked_subset_data(part, ct, xt)
+        state = init_subset_states(
+            model, jax.random.split(key, 4), data, None
+        )
+        finite, accept = _chunk_stats(state)
+        np.testing.assert_array_equal(
+            np.asarray(finite), np.asarray(_finite_subsets(state))
+        )
+        assert np.asarray(finite).all()
+        np.testing.assert_allclose(
+            float(accept), float(np.mean(np.asarray(state.phi_accept)))
+        )
+        # poison one subset's latent draw (one of the small leaves
+        # the guard actually covers): both views must flag exactly
+        # that subset
+        bad = state._replace(u=state.u.at[2].set(jnp.nan))
+        finite_bad = np.asarray(_chunk_stats(bad)[0])
+        np.testing.assert_array_equal(
+            finite_bad, np.asarray(_finite_subsets(bad))
+        )
+        np.testing.assert_array_equal(finite_bad, [1, 1, 0, 1])
+
+    def test_overlap_guard_raises_before_any_save(
+        self, problem, tmp_path
+    ):
+        """nan_guard ordering holds in overlap mode too: a run that
+        is non-finite from chunk one leaves NO checkpoint (the guard
+        fires in the boundary host work, before that boundary's
+        save is submitted)."""
+        part, ct, xt, key = problem
+        c_bad = np.asarray(part.coords).copy()
+        c_bad[1, 0, 0] = np.nan
+        bad = part._replace(coords=jnp.asarray(c_bad))
+        path = str(tmp_path / "g.npz")
+        model = SpatialProbitGP(
+            dataclasses.replace(CFG, chunk_pipeline="overlap"),
+            weight=1,
+        )
+        with pytest.raises(SubsetNaNError) as ei:
+            fit_subsets_chunked(
+                model, bad, ct, xt, key,
+                chunk_iters=6, checkpoint_path=path, nan_guard=True,
+            )
+        assert ei.value.subset_ids == [1]
+        assert not os.path.exists(path)
+
+
+class TestProgressHardening:
+    def test_broken_callback_warns_once_and_run_completes(
+        self, problem, sync_ref
+    ):
+        """An exception inside a user progress callback must not kill
+        the run: one RuntimeWarning, sampling continues, result
+        unchanged."""
+        ref, _ = sync_ref
+        calls = []
+
+        def broken(info):
+            calls.append(info["iteration"])
+            raise RuntimeError("user logging hook is broken")
+
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            res = run(problem, "sync", progress=broken)
+        msgs = [
+            w for w in caught
+            if "progress callback raised" in str(w.message)
+        ]
+        assert len(msgs) == 1  # warned ONCE, not per chunk
+        assert len(calls) == 4  # ... but still called every boundary
+        np.testing.assert_array_equal(
+            np.asarray(ref.param_samples),
+            np.asarray(res.param_samples),
+        )
+
+    def test_progress_abort_still_propagates(self, problem):
+        """A deliberate abort (bench.py's RungSkipped budget gate)
+        subclasses ProgressAbort and must pass through the
+        swallow-and-warn net."""
+
+        class Abort(ProgressAbort):
+            pass
+
+        def gate(info):
+            raise Abort("budget exhausted")
+
+        with pytest.raises(Abort):
+            run(problem, "sync", progress=gate)
+
+
+class TestCheckpointPrimitives:
+    """Pure host-side units: no sampler, no compiles."""
+
+    def test_segment_roundtrip(self, tmp_path):
+        path = str(tmp_path / "c.npz")
+        p = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        w = np.ones((2, 3, 5), np.float32)
+        nbytes = save_segment(path, 7, p, w, 10, 13)
+        assert nbytes > 0
+        assert os.path.exists(segment_path(path, 7))
+        seg = load_segment(path, 7)
+        np.testing.assert_array_equal(seg["param"], p)
+        np.testing.assert_array_equal(seg["w"], w)
+        assert (seg["start"], seg["stop"]) == (10, 13)
+
+    def test_background_writer_orders_and_surfaces_errors(
+        self, tmp_path
+    ):
+        done = []
+        w = BackgroundWriter()
+        w.submit(lambda: done.append(1))
+        w.submit(lambda: done.append(2))
+        w.flush()
+        assert done == [1, 2]
+        # a failing job records its error and all LATER jobs are
+        # skipped (executing past a failure could publish a manifest
+        # whose segment never landed)
+        w.submit(lambda: (_ for _ in ()).throw(OSError("disk full")))
+        w.submit(lambda: done.append(3))
+        w.flush()
+        assert isinstance(w.error, OSError)
+        assert done == [1, 2]
+        w.close()
+        w.close()  # idempotent
+        with pytest.raises(RuntimeError):
+            w.submit(lambda: None)
+
+    def test_degraded_writer_falls_back_to_sync_writes(self, tmp_path):
+        """A background write failure surfaces as ONE warning at the
+        next boundary and the checkpointer degrades to inline writes,
+        re-establishing a full consistent checkpoint."""
+        from smk_tpu.parallel.recovery import _SegmentedCheckpoint
+
+        path = str(tmp_path / "d.npz")
+        state = {"s": np.zeros(3, np.float32)}
+        meta = np.zeros(6, np.int64)
+        ident = np.zeros(4, np.uint32)
+        draws = (
+            np.ones((2, 8, 3), np.float32),
+            np.ones((2, 8, 2), np.float32),
+        )
+        writer = BackgroundWriter()
+        ck = _SegmentedCheckpoint(
+            path, meta, ident, writer=writer,
+            full_draws=lambda filled: (
+                draws[0][:, :filled], draws[1][:, :filled]
+            ),
+        )
+        ck.save(state, ((draws[0][:, :4], draws[1][:, :4]), 0, 4), 4, 4)
+        writer.flush()
+        assert os.path.exists(path)
+        # poison the writer: next boundary must warn + degrade
+        writer.submit(
+            lambda: (_ for _ in ()).throw(OSError("disk full"))
+        )
+        writer.flush()
+        with pytest.warns(RuntimeWarning, match="degrading"):
+            ck.save(
+                state, ((draws[0][:, 4:6], draws[1][:, 4:6]), 4, 6),
+                6, 6,
+            )
+        assert ck.degraded
+        # the degraded write is a FULL rewrite: ONE merged segment at
+        # a FRESH index (never over a file the published manifest
+        # still references — the crash-window contract), with the
+        # superseded segment 0 unlinked after the manifest landed
+        assert ck.n_segments == 1
+        assert ck.seg_base == 1
+        seg = load_segment(path, ck.seg_base)
+        assert (seg["start"], seg["stop"]) == (0, 6)
+        assert not os.path.exists(segment_path(path, 0))
+        writer.close()
+
+    def test_pipeline_stats_aggregate(self):
+        ps = ChunkPipelineStats(mode="overlap")
+        ps.record_chunk(
+            chunk=0, dispatch_s=0.1, host_work_s=0.5,
+            host_stall_s=0.0, d2h_bytes=100,
+        )
+        ps.record_chunk(
+            chunk=1, dispatch_s=0.1, host_work_s=0.25,
+            host_stall_s=0.25, d2h_bytes=100,
+        )
+        ps.add_ckpt_write(0.2, 1000)
+        ps.add_ckpt_write(0.3, 1100)
+        ps.total_wall_s = 2.0
+        agg = ps.aggregate()
+        assert agg["mode"] == "overlap"
+        assert agg["n_chunks"] == 2
+        assert agg["host_stall_frac"] == pytest.approx(0.125)
+        assert agg["overlap_efficiency"] == pytest.approx(0.875)
+        assert agg["d2h_bytes"] == 200
+        assert agg["ckpt_bytes"] == 2100
+        assert agg["ckpt_boundary_bytes"] == [1000, 1100]

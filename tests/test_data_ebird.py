@@ -254,6 +254,7 @@ class TestCsvLoaderRealWorldMess:
 
 
 class TestEndToEnd:
+    @pytest.mark.slow  # r8 gate window rebudget (ROADMAP 870 s, rc=0)
     def test_fit_meta_kriging_on_proxy(self):
         """Config-4 shape: the q=2 proxy through the full pipeline
         (logit link, the reference's own; K-subset fan-out)."""

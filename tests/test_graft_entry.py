@@ -29,6 +29,7 @@ def test_entry_compiles_and_runs():
     assert bool(jax.numpy.isfinite(w_grid).all())
 
 
+@pytest.mark.slow  # r8 gate window rebudget (ROADMAP 870 s, rc=0)
 def test_dryrun_multichip_in_process(capsys):
     # conftest gives this process 8 virtual CPU devices, so the body
     # must run directly (no subprocess).
@@ -38,6 +39,7 @@ def test_dryrun_multichip_in_process(capsys):
     assert "dryrun_multichip ok" in capsys.readouterr().out
 
 
+@pytest.mark.slow  # r8 gate window rebudget (ROADMAP 870 s, rc=0)
 def test_dryrun_multichip_reexec_path():
     # Simulate the driver: a fresh interpreter with NO device-count
     # flag initializes a 1-device backend *before* calling the entry.

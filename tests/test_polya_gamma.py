@@ -116,7 +116,12 @@ def test_pg_ks_vs_exact_devroye(b, c):
     assert pval > 1e-3, (d, pval)
 
 
-@pytest.mark.parametrize("b", [1, 4])
+@pytest.mark.parametrize(
+    "b",
+    # b=4 runs ~50 s per c cell on this host — outside the rc=0 tier-1
+    # window (r8 gate rebudget); b=1 keeps the moment checks in-gate
+    [1, pytest.param(4, marks=pytest.mark.slow)],
+)
 @pytest.mark.parametrize("c", [0.0, 0.5, 2.0, 8.0])
 def test_pg_moments(b, c):
     key = jax.random.key(0)

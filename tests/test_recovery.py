@@ -37,6 +37,7 @@ def problem():
 
 
 class TestCheckpointedFit:
+    @pytest.mark.slow  # r8 gate window rebudget (ROADMAP 870 s, rc=0)
     def test_uninterrupted_matches_vmap(self, problem, tmp_path):
         model, part, ct, xt, key = problem
         res_ref = fit_subsets_vmap(model, part, ct, xt, key)
@@ -53,6 +54,7 @@ class TestCheckpointedFit:
             rtol=2e-3, atol=2e-3,
         )
 
+    @pytest.mark.slow  # r8 gate window rebudget (ROADMAP 870 s, rc=0)
     def test_kill_and_resume_is_exact(self, problem, tmp_path):
         """Interrupted + resumed must equal uninterrupted, exactly:
         both runs execute the identical chunked program."""
@@ -177,6 +179,7 @@ class TestApiCheckpointPath:
 
 
 class TestShardRecovery:
+    @pytest.mark.slow  # r8 gate window rebudget (ROADMAP 870 s, rc=0)
     def test_rerun_restores_corrupted_shard(self, problem):
         model, part, ct, xt, key = problem
         res = fit_subsets_vmap(model, part, ct, xt, key)
@@ -208,6 +211,7 @@ class TestShardRecovery:
         assert find_failed_subsets(res).size == 0
 
 
+@pytest.mark.slow  # r8 gate window rebudget (ROADMAP 870 s, rc=0)
 class TestUnifiedExecutor:
     """VERDICT r2 #3: sharding, K-chunking, iteration-chunking,
     checkpointing and progress reporting compose in one executor —
@@ -402,6 +406,7 @@ class TestNaNGuard:
             )
         assert not os.path.exists(path)
 
+    @pytest.mark.slow  # r8 gate window rebudget (ROADMAP 870 s, rc=0)
     def test_clean_run_unchanged_by_guard(self, problem):
         from smk_tpu.parallel.recovery import fit_subsets_chunked
 
