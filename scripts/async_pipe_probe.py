@@ -46,7 +46,6 @@ sys.path.insert(
 )
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 OUT = os.path.join(

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from smk_tpu.config import SMKConfig
-from smk_tpu.models.probit_gp import SpatialGPSampler, SubsetData
+from smk_tpu.models.probit_gp import SpatialGPSampler
 from smk_tpu.ops.chol import jittered_cholesky, tri_solve
 from smk_tpu.ops.truncnorm import truncated_normal
 

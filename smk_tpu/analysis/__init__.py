@@ -1,0 +1,46 @@
+"""Repo-native correctness tooling (ISSUE 6).
+
+Two layers:
+
+- ``smklint`` — AST static analysis (engine.py + rules.py, CLI in
+  lint.py): mechanical enforcement of the JAX invariants five PRs of
+  hot-path work left as conventions (batching-rule coverage, JAX-PRNG
+  determinism, no host sync inside traced code, donation discipline,
+  pinned-XLA-module hygiene, tier-1 test budgets). Run it as
+  ``python -m smk_tpu.analysis.lint <paths>`` or via scripts/lint.py.
+- runtime sanitizers (sanitizers.py): ``recompile_guard`` (fails a
+  declared-stable hot path that recompiles — ROADMAP open item 3's
+  churn, measured instead of remembered) and ``transfer_guard_strict``
+  (pins that the overlap chunk pipeline performs only *explicit*,
+  ledgered device-to-host copies).
+
+The rule catalogue with the invariant each protects lives in
+``smk_tpu/analysis/RULES.md``.
+"""
+
+from smk_tpu.analysis.engine import Finding, lint_paths, lint_source
+
+_SANITIZER_EXPORTS = (
+    "RecompileError",
+    "TransferLedger",
+    "explicit_d2h",
+    "recompile_guard",
+    "transfer_guard_strict",
+)
+
+__all__ = [
+    "Finding",
+    "lint_paths",
+    "lint_source",
+    *_SANITIZER_EXPORTS,
+]
+
+
+def __getattr__(name):
+    # sanitizers import jax; the lint CLI must stay stdlib-only, so
+    # the runtime layer loads lazily
+    if name in _SANITIZER_EXPORTS:
+        from smk_tpu.analysis import sanitizers
+
+        return getattr(sanitizers, name)
+    raise AttributeError(name)

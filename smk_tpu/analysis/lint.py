@@ -1,0 +1,90 @@
+"""smklint CLI: ``python -m smk_tpu.analysis.lint <paths...>``.
+
+Exit status 0 = no unsuppressed findings, 1 = findings, 2 = usage.
+Deliberately imports no jax — the whole run is stdlib AST work and
+must finish in seconds on CPU (the tier-1 gate runs it as a test).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from smk_tpu.analysis.engine import lint_paths
+from smk_tpu.analysis.rules import ALL_RULES
+
+
+def _list_rules() -> str:
+    out = ["smklint rules (suppress: # smklint: disable=<ID> -- <why>)"]
+    out.append(
+        "  SMK100 bare-suppression: a suppression without a "
+        "justification (` -- reason`) or naming an unknown rule id is "
+        "itself a finding and cannot be suppressed"
+    )
+    for rule in ALL_RULES:
+        out.append(f"  {rule.id} {rule.name}: {rule.doc}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m smk_tpu.analysis.lint",
+        description=(
+            "repo-native static analysis enforcing the codebase's "
+            "JAX invariants (see smk_tpu/analysis/RULES.md)"
+        ),
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files or directories to lint (e.g. smk_tpu/ tests/)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalogue and exit",
+    )
+    parser.add_argument(
+        "--select", default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        print(_list_rules())
+        return 0
+    if not args.paths:
+        parser.print_usage()
+        return 2
+
+    rules = ALL_RULES
+    if args.select:
+        wanted = {s.strip() for s in args.select.split(",") if s.strip()}
+        unknown = wanted - {r.id for r in ALL_RULES}
+        if unknown:
+            print(f"unknown rule id(s): {sorted(unknown)}", file=sys.stderr)
+            return 2
+        rules = [r for r in ALL_RULES if r.id in wanted]
+
+    t0 = time.perf_counter()
+    try:
+        findings = lint_paths(args.paths, rules=rules)
+    except (FileNotFoundError, ValueError) as e:
+        # a typo'd operand must never produce a false-green gate
+        print(f"smklint: {e}", file=sys.stderr)
+        return 2
+    dt = time.perf_counter() - t0
+    for f in findings:
+        print(f.render())
+    n_files = len(set(f.path for f in findings))
+    if findings:
+        print(
+            f"smklint: {len(findings)} finding(s) in {n_files} "
+            f"file(s) [{dt:.2f}s]"
+        )
+        return 1
+    print(f"smklint: clean [{dt:.2f}s]")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,1026 @@
+"""smklint rules SMK101–SMK106 — the repo's JAX invariants, each one
+traceable to the PR that established it (see analysis/RULES.md).
+
+All rules are pure-AST (no jax import). Shared machinery:
+
+- attribute-chain resolution (``lax.optimization_barrier`` →
+  ``("lax", "optimization_barrier")``);
+- traced-context discovery: functions that run under trace — jitted
+  defs/lambdas, scan/cond/while/fori/map/switch bodies, vmap/pmap/
+  grad'd functions — plus everything they (transitively) call within
+  the module, including ``self.<method>`` calls resolved by name.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterator, List, Set, Tuple
+
+from smk_tpu.analysis.engine import Finding, LintContext, LintModule
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+_JAX_ROOTS = {"jax", "jnp", "lax", "jsp", "jxla"}
+_NP_ROOTS = {"np", "numpy", "onp"}
+
+
+def attr_chain(node: ast.AST) -> Tuple[str, ...]:
+    """("jax", "lax", "scan") for jax.lax.scan; () when not a plain
+    name/attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return ()
+
+
+def _contains_jax_call(node: ast.AST) -> bool:
+    """Does this expression call into jax/jnp/lax (i.e. can it yield a
+    tracer)?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            chain = attr_chain(sub.func)
+            if chain and chain[0] in _JAX_ROOTS:
+                return True
+    return False
+
+
+class _FuncIndex(ast.NodeVisitor):
+    """Every FunctionDef/AsyncFunctionDef/Lambda in the module, with
+    its enclosing function (for nesting propagation)."""
+
+    def __init__(self):
+        self.funcs: List[ast.AST] = []
+        self.parent: dict = {}
+        self.by_name: dict = {}
+        self._stack: List[ast.AST] = []
+
+    def _enter(self, node):
+        self.funcs.append(node)
+        self.parent[node] = self._stack[-1] if self._stack else None
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.by_name.setdefault(node.name, []).append(node)
+        self._stack.append(node)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _enter
+    visit_AsyncFunctionDef = _enter
+    visit_Lambda = _enter
+
+    def visit_Assign(self, node):
+        # `body = lambda c, i: ...` — the lambda is reachable by the
+        # assigned name (lax.scan(body, ...) must resolve to it)
+        if (
+            len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Lambda)
+        ):
+            self.by_name.setdefault(
+                node.targets[0].id, []
+            ).append(node.value)
+        self.generic_visit(node)
+
+
+# callables-by-position for the tracing higher-order functions
+_TRACING_CALLEE_ARGS = {
+    "scan": (0,),
+    "map": (0,),
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+    "cond": (1, 2),
+    "switch": (1,),  # list/tuple of branches
+    "jit": (0,),
+    "pjit": (0,),
+    "vmap": (0,),
+    "pmap": (0,),
+    "grad": (0,),
+    "value_and_grad": (0,),
+    "checkpoint": (0,),
+    "remat": (0,),
+    "shard_map": (0,),
+    "custom_jvp": (0,),
+    "custom_vjp": (0,),
+}
+
+
+# heads that collide with builtins/other libraries: only treat them as
+# tracing when spelled with an explicit jax-ish root (lax.map yes,
+# builtin map(f, xs) no)
+_AMBIGUOUS_HEADS = {"map", "checkpoint", "remat", "switch"}
+
+
+def _callee_exprs(call: ast.Call) -> List[ast.AST]:
+    chain = attr_chain(call.func)
+    if not chain:
+        return []
+    head = chain[-1]
+    if head not in _TRACING_CALLEE_ARGS:
+        return []
+    # require a jax-ish root (or a bare name like `jit`, `scan` that
+    # was imported directly)
+    if len(chain) > 1 and chain[0] not in _JAX_ROOTS:
+        return []
+    if len(chain) == 1 and head in _AMBIGUOUS_HEADS:
+        return []
+    # functools.partial(jax.jit, ...) handled at the decorator site
+    out = []
+    for pos in _TRACING_CALLEE_ARGS[head]:
+        if pos < len(call.args):
+            arg = call.args[pos]
+            if isinstance(arg, (ast.List, ast.Tuple)):
+                out.extend(arg.elts)  # lax.switch branch lists
+            else:
+                out.append(arg)
+    return out
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    chain = attr_chain(dec)
+    if chain and chain[-1] in ("jit", "pjit"):
+        return True
+    if isinstance(dec, ast.Call):
+        chain = attr_chain(dec.func)
+        if chain and chain[-1] in ("jit", "pjit"):
+            return True
+        if chain and chain[-1] == "partial" and dec.args:
+            inner = attr_chain(dec.args[0])
+            if inner and inner[-1] in ("jit", "pjit"):
+                return True
+    return False
+
+
+def traced_functions(module: LintModule) -> Set[ast.AST]:
+    """Function nodes whose bodies execute under a jax trace, closed
+    transitively over same-module calls (Name calls and self.<name>
+    method calls, resolved by name)."""
+    idx = _FuncIndex()
+    idx.visit(module.tree)
+    traced: Set[ast.AST] = set()
+    traced_names: Set[str] = set()
+
+    def mark_expr(expr: ast.AST):
+        if isinstance(expr, ast.Lambda):
+            traced.add(expr)
+        else:
+            chain = attr_chain(expr)
+            if chain:
+                traced_names.add(chain[-1])
+
+    # roots: jitted defs + callees of tracing higher-order calls
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                traced.add(node)
+        if isinstance(node, ast.Call):
+            for expr in _callee_exprs(node):
+                mark_expr(expr)
+
+    for name in traced_names:
+        traced.update(idx.by_name.get(name, []))
+
+    # propagate: nested defs + functions called from traced bodies
+    changed = True
+    while changed:
+        changed = False
+        for fn in idx.funcs:
+            if fn in traced:
+                continue
+            parent = idx.parent.get(fn)
+            if parent is not None and parent in traced:
+                traced.add(fn)
+                changed = True
+        called: Set[str] = set()
+        for fn in traced:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    chain = attr_chain(node.func)
+                    if len(chain) == 1:
+                        called.add(chain[0])
+                    elif len(chain) == 2 and chain[0] == "self":
+                        called.add(chain[1])
+        for name in called:
+            for fn in idx.by_name.get(name, []):
+                if fn not in traced:
+                    traced.add(fn)
+                    changed = True
+    return traced
+
+
+def _own_nodes(fn: ast.AST, idx_funcs: Set[ast.AST]) -> Iterator[ast.AST]:
+    """Walk fn's body without descending into nested function nodes
+    (they are visited as their own traced entries)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ) and node in idx_funcs:
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _in_zone(module: LintModule, *zones: str) -> bool:
+    norm = module.norm_path()
+    return any(z in norm for z in zones)
+
+
+class Rule:
+    id = "SMK000"
+    name = "abstract"
+    doc = ""
+
+    def applies(self, module: LintModule) -> bool:
+        return True
+
+    def check(
+        self, module: LintModule, ctx: LintContext
+    ) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, module: LintModule, node, msg: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(self.id, module.path, line, f"[{self.name}] {msg}")
+
+
+# ---------------------------------------------------------------------------
+# SMK101 — batching-rule coverage
+# ---------------------------------------------------------------------------
+
+# Primitives KNOWN to ship without a batching rule on the pinned jax
+# (0.4.x): using one in-tree without registering a rule in the same
+# module reintroduces the vmapped-sampler crash PR 1 fixed.
+KNOWN_UNBATCHED_PRIMITIVES = {"optimization_barrier"}
+
+
+class BatchingRuleRule(Rule):
+    id = "SMK101"
+    name = "batching-rule"
+    doc = (
+        "every jax primitive defined in-tree, and every use of a "
+        "primitive known to lack a batching rule on the pinned jax "
+        "(optimization_barrier on 0.4.x), must come with a "
+        "batching-rule registration in the same module — the vmapped "
+        "collapsed sampler crashed on exactly this (PR 1)"
+    )
+
+    def check(self, module, ctx):
+        registered: Set[str] = set()  # source-ish keys of covered prims
+        aliases: dict = {}  # name -> attr-chain string it aliases
+        created: dict = {}  # var name -> (line, primitive name string)
+
+        # pass 1: aliases (`_ob_p = lax.optimization_barrier_p`) —
+        # ast.walk order is breadth-first, not source order, so the
+        # registration pass below must see a complete alias table
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                chain = attr_chain(node.value)
+                if isinstance(tgt, ast.Name) and chain:
+                    aliases[tgt.id] = ".".join(chain)
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name) and isinstance(
+                    node.value, ast.Call
+                ):
+                    cchain = attr_chain(node.value.func)
+                    if cchain and cchain[-1] == "Primitive":
+                        pname = "?"
+                        if node.value.args and isinstance(
+                            node.value.args[0], ast.Constant
+                        ):
+                            pname = str(node.value.args[0].value)
+                        created[tgt.id] = (node.lineno, pname)
+                # registration: <...>.primitive_batchers[key] = fn
+                if isinstance(tgt, ast.Subscript):
+                    tchain = attr_chain(tgt.value)
+                    if tchain and tchain[-1] == "primitive_batchers":
+                        kchain = attr_chain(tgt.slice)
+                        key = ".".join(kchain) if kchain else ""
+                        registered.add(key)
+                        if kchain and kchain[-1] in aliases:
+                            registered.add(aliases[kchain[-1]])
+            if isinstance(node, ast.Call):
+                cchain = attr_chain(node.func)
+                if cchain and cchain[-1] in (
+                    "defvectorized", "defbroadcasting"
+                ):
+                    for arg in node.args:
+                        achain = attr_chain(arg)
+                        if achain:
+                            key = ".".join(achain)
+                            registered.add(key)
+                            if achain[-1] in aliases:
+                                registered.add(aliases[achain[-1]])
+
+        def covered(prim_name: str) -> bool:
+            return any(prim_name in key for key in registered)
+
+        for var, (line, pname) in created.items():
+            if not (covered(var) or covered(pname)):
+                yield Finding(
+                    self.id, module.path, line,
+                    f"[{self.name}] primitive {pname!r} ({var}) is "
+                    "defined here with no batching-rule registration "
+                    "in this module (batching.primitive_batchers[...] "
+                    "or defvectorized/defbroadcasting) — any vmapped "
+                    "program binding it will crash",
+                )
+
+        for node in ast.walk(module.tree):
+            chain = attr_chain(node) if isinstance(
+                node, ast.Attribute
+            ) else ()
+            if not chain:
+                continue
+            leaf = chain[-1]
+            base = leaf[:-2] if leaf.endswith("_p") else leaf
+            if base in KNOWN_UNBATCHED_PRIMITIVES and not covered(base):
+                yield Finding(
+                    self.id, module.path, node.lineno,
+                    f"[{self.name}] {'.'.join(chain)} is used but jax "
+                    "0.4.x ships no batching rule for "
+                    f"{base!r} and this module registers none — a "
+                    "vmapped caller (every K-fan-out executor path) "
+                    "dies with NotImplementedError; register "
+                    "batching.primitive_batchers[...] as "
+                    "models/probit_gp.py does",
+                )
+                break  # one finding per module is actionable enough
+
+
+# ---------------------------------------------------------------------------
+# SMK102 — host nondeterminism
+# ---------------------------------------------------------------------------
+
+_LEGACY_NP_RANDOM = {
+    "seed", "normal", "uniform", "rand", "randn", "randint",
+    "random", "choice", "permutation", "shuffle", "binomial",
+    "poisson", "gamma", "beta", "exponential", "standard_normal",
+    "random_sample", "get_state", "set_state", "sample",
+}
+_STRICT_ZONES = ("smk_tpu/models", "smk_tpu/ops", "smk_tpu/parallel")
+
+
+class HostNondeterminismRule(Rule):
+    id = "SMK102"
+    name = "host-nondeterminism"
+    doc = (
+        "sampler/ops/parallel modules must draw randomness from the "
+        "JAX PRNG only: np.random / stdlib random / time-seeded "
+        "generators make chains unreproducible (the reference's "
+        "unseeded workers are the bug class; conftest pins explicit "
+        "seeds). Elsewhere in smk_tpu/, unseeded global-state "
+        "np.random use is still flagged."
+    )
+
+    def applies(self, module):
+        return _in_zone(module, "smk_tpu/")
+
+    def check(self, module, ctx):
+        strict = _in_zone(module, *_STRICT_ZONES)
+        random_module_aliases: Set[str] = set()
+        random_member_names: Set[str] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "random":
+                        random_module_aliases.add(a.asname or "random")
+            if isinstance(node, ast.ImportFrom):
+                if node.module == "random":
+                    for a in node.names:
+                        random_member_names.add(a.asname or a.name)
+
+        for node in ast.walk(module.tree):
+            chain = attr_chain(node) if isinstance(
+                node, ast.Attribute
+            ) else ()
+            if (
+                len(chain) >= 3
+                and chain[0] in _NP_ROOTS
+                and chain[1] == "random"
+            ):
+                leaf = chain[2]
+                if strict:
+                    yield self.finding(
+                        module, node,
+                        f"np.random.{leaf} inside a sampler/ops/"
+                        "parallel module — all randomness on the fit "
+                        "path must come from the carried jax PRNG key",
+                    )
+                elif leaf in _LEGACY_NP_RANDOM:
+                    yield self.finding(
+                        module, node,
+                        f"np.random.{leaf} uses numpy's GLOBAL "
+                        "generator state — use a seeded "
+                        "np.random.default_rng(seed) (data/utils "
+                        "modules) or the jax PRNG",
+                    )
+            if isinstance(node, ast.Call):
+                fchain = attr_chain(node.func)
+                # unseeded default_rng() anywhere in smk_tpu/
+                if (
+                    fchain
+                    and fchain[-1] == "default_rng"
+                    and not node.args
+                    and not node.keywords
+                ):
+                    yield self.finding(
+                        module, node,
+                        "np.random.default_rng() without a seed is "
+                        "OS-entropy nondeterminism — pass an explicit "
+                        "seed",
+                    )
+                # stdlib random module calls
+                if (
+                    len(fchain) == 2
+                    and fchain[0] in random_module_aliases
+                ) or (
+                    len(fchain) == 1
+                    and fchain[0] in random_member_names
+                ):
+                    yield self.finding(
+                        module, node,
+                        f"stdlib random.{fchain[-1]} in smk_tpu/ — "
+                        "use the jax PRNG (or a seeded numpy "
+                        "Generator outside the fit path)",
+                    )
+                # time-seeded generators
+                if fchain and (
+                    "rng" in fchain[-1].lower()
+                    or "seed" in fchain[-1].lower()
+                    or fchain[-1] in ("PRNGKey", "key")
+                ):
+                    for arg in ast.walk(node):
+                        if isinstance(arg, ast.Call):
+                            achain = attr_chain(arg.func)
+                            if achain and achain[-2:] in (
+                                ("time", "time"),
+                                ("time", "time_ns"),
+                            ):
+                                yield self.finding(
+                                    module, node,
+                                    "wall-clock-seeded generator — "
+                                    "seeds must be explicit and "
+                                    "reproducible",
+                                )
+                                break
+
+
+# ---------------------------------------------------------------------------
+# SMK103 — host sync inside traced code
+# ---------------------------------------------------------------------------
+
+_SYNC_ATTRS = {
+    "item": ".item() forces a device->host sync",
+    "tolist": ".tolist() forces a device->host sync",
+    "block_until_ready": ".block_until_ready() blocks the host",
+    "copy_to_host_async": ".copy_to_host_async() is a host-side "
+    "transfer call",
+}
+_NP_MATERIALIZE = {"asarray", "array", "ascontiguousarray", "copyto", "save", "savez"}
+
+
+class HostSyncInTracedRule(Rule):
+    id = "SMK103"
+    name = "host-sync-in-traced"
+    doc = (
+        "no host synchronization inside traced code: .item()/"
+        ".tolist()/.block_until_ready()/np.asarray/jax.device_get, "
+        "or float()/int()/bool()/if on a jax expression, inside "
+        "lax.scan/cond/while/fori bodies or jitted functions — "
+        "tracers make these a crash at best and a silent "
+        "per-iteration device stall at worst (the chunk hot loop's "
+        "guard fetch is deliberately one tiny separate program)"
+    )
+
+    def check(self, module, ctx):
+        idx = _FuncIndex()
+        idx.visit(module.tree)
+        all_funcs = set(idx.funcs)
+        for fn in traced_functions(module):
+            for node in _own_nodes(fn, all_funcs):
+                yield from self._check_node(module, node)
+
+    def _check_node(self, module, node):
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                if attr in _SYNC_ATTRS:
+                    yield self.finding(
+                        module, node,
+                        f"{_SYNC_ATTRS[attr]} inside a traced "
+                        "function — hoist it to the host boundary",
+                    )
+                    return
+                chain = attr_chain(node.func)
+                if (
+                    len(chain) >= 2
+                    and chain[0] in _NP_ROOTS
+                    and chain[-1] in _NP_MATERIALIZE
+                ):
+                    yield self.finding(
+                        module, node,
+                        f"{'.'.join(chain)}(...) materializes to host "
+                        "numpy inside a traced function — use jnp, or "
+                        "fetch at the host boundary",
+                    )
+                    return
+                if chain[-2:] == ("jax", "device_get"):
+                    yield self.finding(
+                        module, node,
+                        "jax.device_get inside a traced function",
+                    )
+                    return
+            if isinstance(node.func, ast.Name):
+                # the from-import spelling: `device_get(x)`
+                if node.func.id == "device_get":
+                    yield self.finding(
+                        module, node,
+                        "device_get inside a traced function",
+                    )
+                    return
+                if node.func.id in ("float", "int", "bool") and (
+                    node.args and _contains_jax_call(node.args[0])
+                ):
+                    yield self.finding(
+                        module, node,
+                        f"{node.func.id}() on a jax expression inside "
+                        "a traced function concretizes a tracer — "
+                        "keep it an array (or compute the scalar on "
+                        "the host side)",
+                    )
+        if isinstance(node, (ast.If, ast.While)) and _contains_jax_call(
+            node.test
+        ):
+            yield self.finding(
+                module, node,
+                "branching on a jax expression inside a traced "
+                "function is an implicit bool() on a tracer — use "
+                "lax.cond/jnp.where",
+            )
+        if isinstance(node, ast.Assert) and _contains_jax_call(node.test):
+            yield self.finding(
+                module, node,
+                "assert on a jax expression inside a traced function "
+                "is an implicit bool() on a tracer — use "
+                "checkify/debug.check or move it to the host",
+            )
+
+
+# ---------------------------------------------------------------------------
+# SMK104 — donation discipline
+# ---------------------------------------------------------------------------
+
+
+class DonationDisciplineRule(Rule):
+    id = "SMK104"
+    name = "donation-discipline"
+    doc = (
+        "donated buffers are invalidated AT DISPATCH on every "
+        "backend: a variable passed at a donate_argnums position must "
+        "not be read again unless rebound from the call's result, and "
+        "copy_to_host_async must follow the clone-then-copy pattern "
+        "(snapshot a fresh on-device clone, never a buffer a later "
+        "dispatch may receive donated) — executor.HostSnapshot is the "
+        "reference implementation (PR 5)"
+    )
+
+    def check(self, module, ctx):
+        donating: dict = {}  # callable name -> donated positions
+        for node in ast.walk(module.tree):
+            value = None
+            target_name = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                if isinstance(node.targets[0], ast.Name):
+                    target_name = node.targets[0].id
+                    value = node.value
+            if isinstance(value, ast.Call):
+                fchain = attr_chain(value.func)
+                if fchain and fchain[-1] in ("jit", "pjit"):
+                    for kw in value.keywords:
+                        if kw.arg in (
+                            "donate_argnums", "donate_argnames"
+                        ):
+                            donating[target_name] = self._positions(kw)
+        if donating:
+            idx = _FuncIndex()
+            idx.visit(module.tree)
+            for fn in idx.funcs:
+                if isinstance(
+                    fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    yield from self._check_read_after_donate(
+                        module, fn, donating
+                    )
+        yield from self._check_clone_then_copy(module)
+
+    @staticmethod
+    def _positions(kw) -> Tuple[int, ...]:
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            return tuple(
+                e.value for e in v.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, int)
+            )
+        return ()
+
+    def _check_read_after_donate(self, module, fn, donating):
+        """Within one function body, statement order approximates
+        execution order (good enough for the linear hot-loop code this
+        rule protects)."""
+        events = []  # (line, kind, name) kind: donate|read|rebind
+        # a donating call inside a `return` terminates the flow — no
+        # read after it can execute in this function, so it is not a
+        # live donation (the `return f(donated)` branches of
+        # executor.write_draws are the canonical safe shape)
+        returned_calls = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                for sub in ast.walk(node.value):
+                    returned_calls.add(id(sub))
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and id(node) not in returned_calls:
+                fchain = attr_chain(node.func)
+                if len(fchain) == 1 and fchain[0] in donating:
+                    for pos in donating[fchain[0]]:
+                        if pos < len(node.args) and isinstance(
+                            node.args[pos], ast.Name
+                        ):
+                            events.append((
+                                node.lineno, "donate",
+                                node.args[pos].id, node,
+                            ))
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load):
+                    events.append((node.lineno, "read", node.id, node))
+                elif isinstance(node.ctx, ast.Store):
+                    events.append((
+                        node.lineno, "rebind", node.id, node
+                    ))
+        # within one line, order donate < read < rebind: in
+        # `x = f(x, y)` the store target is walked before the call,
+        # but the rebind semantically happens after the dispatch
+        prio = {"donate": 0, "read": 1, "rebind": 2}
+        events.sort(key=lambda e: (e[0], prio[e[1]]))
+        live_donated: dict = {}
+        for line, kind, name, node in events:
+            if kind == "donate":
+                live_donated[name] = line
+            elif kind == "rebind":
+                live_donated.pop(name, None)
+            elif kind == "read" and name in live_donated:
+                if line <= live_donated[name]:
+                    continue  # the donating call itself / same stmt
+                yield Finding(
+                    self.id, module.path, line,
+                    f"[{self.name}] {name!r} was donated at line "
+                    f"{live_donated[name]} and is read again here — "
+                    "its buffer is invalid after dispatch; rebind "
+                    "from the call result or snapshot "
+                    "(HostSnapshot) before donating",
+                )
+                live_donated.pop(name, None)
+
+    def _check_clone_then_copy(self, module):
+        idx = _FuncIndex()
+        idx.visit(module.tree)
+        for fn in idx.funcs:
+            cloned: Set[str] = set()
+            stmts = []
+            for node in ast.walk(fn):
+                if hasattr(node, "lineno"):
+                    stmts.append(node)
+            stmts.sort(key=lambda n: n.lineno)
+            for node in stmts:
+                if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call
+                ):
+                    fchain = attr_chain(node.value.func)
+                    if fchain and (
+                        "clone" in fchain[-1] or "copy" in fchain[-1]
+                    ):
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name):
+                                cloned.add(tgt.id)
+                if isinstance(node, ast.Call):
+                    is_copy_call = (
+                        isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "copy_to_host_async"
+                    )
+                    getattr_copy = (
+                        isinstance(node.func, ast.Name)
+                        and node.func.id == "getattr"
+                        and len(node.args) >= 2
+                        and isinstance(node.args[1], ast.Constant)
+                        and node.args[1].value == "copy_to_host_async"
+                    )
+                    if getattr_copy:
+                        yield Finding(
+                            self.id, module.path, node.lineno,
+                            f"[{self.name}] copy_to_host_async "
+                            "fetched via getattr — smklint cannot "
+                            "see the clone-then-copy pattern here; "
+                            "restructure or suppress with the "
+                            "justification",
+                        )
+                    if is_copy_call:
+                        recv = attr_chain(node.func.value)
+                        if len(recv) == 1 and recv[0] not in cloned:
+                            yield Finding(
+                                self.id, module.path, node.lineno,
+                                f"[{self.name}] "
+                                f"{recv[0]}.copy_to_host_async() "
+                                "without an on-device clone first — "
+                                "if this buffer is later donated the "
+                                "async copy races the dispatch "
+                                "invalidation (clone with jnp.copy/"
+                                "_device_clone as HostSnapshot does)",
+                            )
+
+
+# ---------------------------------------------------------------------------
+# SMK105 — pinned-program (module-context) hygiene
+# ---------------------------------------------------------------------------
+
+
+class PinnedProgramRule(Rule):
+    id = "SMK105"
+    name = "pinned-program"
+    doc = (
+        "functions marked `# smklint: pinned-program` are their own "
+        "deliberately-separate XLA programs (fusing them into the "
+        "chunk program changes its module context and XLA:CPU "
+        "compiles identical fp32 arithmetic to different low bits per "
+        "module — the bit-identity contract): each must be referenced "
+        "by name in a tests/ file (the golden-pin reference) and must "
+        "never be called from a traced context in its module"
+    )
+
+    def check(self, module, ctx):
+        pinned: List[ast.AST] = []
+        for node in ast.walk(module.tree):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and module.directive_near_def(node, "pinned-program"):
+                pinned.append(node)
+        if not pinned:
+            return
+        idx = _FuncIndex()
+        idx.visit(module.tree)
+        all_funcs = set(idx.funcs)
+        traced = traced_functions(module)
+        pinned_names = {p.name for p in pinned}
+        pinned_nodes = set(pinned)
+        for p in pinned:
+            if not ctx.referenced_in_tests(p.name):
+                yield self.finding(
+                    module, p,
+                    f"pinned program {p.name!r} has no reference "
+                    "under tests/ — a pin without a golden-pin test "
+                    "is unenforced; add (or name it in) a regression "
+                    "test",
+                )
+        # a pinned function's OWN @jax.jit is the point (it is its own
+        # XLA module); what must never happen is traced code in this
+        # module calling it — by name inside a traced body, or handed
+        # straight to a tracing higher-order function (lax.scan(f, …))
+        for fn in traced:
+            if fn in pinned_nodes:
+                continue
+            for node in _own_nodes(fn, all_funcs):
+                if isinstance(node, ast.Call):
+                    chain = attr_chain(node.func)
+                    if chain and chain[-1] in pinned_names:
+                        yield Finding(
+                            self.id, module.path, node.lineno,
+                            f"[{self.name}] traced code calls pinned "
+                            f"program {chain[-1]!r} — that fuses it "
+                            "into this trace's XLA module; call it "
+                            "from the host boundary instead",
+                        )
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                for expr in _callee_exprs(node):
+                    chain = attr_chain(expr)
+                    if chain and chain[-1] in pinned_names:
+                        yield Finding(
+                            self.id, module.path, node.lineno,
+                            f"[{self.name}] pinned program "
+                            f"{chain[-1]!r} is handed to a tracing "
+                            "transform here — it would be retraced "
+                            "into a new module context instead of "
+                            "staying the one pinned program",
+                        )
+
+
+# ---------------------------------------------------------------------------
+# SMK106 — tier-1 test budget marks
+# ---------------------------------------------------------------------------
+
+
+def _grandfathered(conftest_path: str) -> Set[str]:
+    """Extract SLOW_GATE_GRANDFATHERED from tests/conftest.py — the
+    one source of truth the runtime gate already uses."""
+    try:
+        with open(conftest_path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Name)
+                    and tgt.id == "SLOW_GATE_GRANDFATHERED"
+                    and isinstance(node.value, (ast.Set, ast.List, ast.Tuple))
+                ):
+                    return {
+                        e.value for e in node.value.elts
+                        if isinstance(e, ast.Constant)
+                    }
+    return set()
+
+
+def _has_slow_mark(node) -> bool:
+    for dec in getattr(node, "decorator_list", []):
+        chain = attr_chain(dec)
+        if not chain and isinstance(dec, ast.Call):
+            chain = attr_chain(dec.func)
+        if chain and "slow" in chain[-1:]:
+            return True
+        if chain[-2:] == ("mark", "slow"):
+            return True
+    return False
+
+
+def _module_pytestmark_slow(tree: ast.Module) -> bool:
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "pytestmark":
+                    return "slow" in ast.dump(node.value)
+    return False
+
+
+class TestBudgetRule(Rule):
+    id = "SMK106"
+    name = "test-budget"
+    doc = (
+        "new test files (not grandfathered in tests/conftest.py's "
+        "SLOW_GATE_GRANDFATHERED) must declare every test's budget "
+        "statically: a @pytest.mark.slow mark, a per-test `# smklint: "
+        "budget=<why fast>` comment, or a module-level `# smklint: "
+        "test-budget=<why fast>` — the static complement of "
+        "conftest's in-flight 60 s runtime gate protecting the tier-1 "
+        "870 s window"
+    )
+
+    def applies(self, module):
+        norm = module.norm_path()
+        base = module.basename
+        return (
+            base.startswith("test_")
+            and base.endswith(".py")
+            and ("/tests/" in norm or norm.startswith("tests/"))
+        )
+
+    def check(self, module, ctx):
+        conftest = os.path.join(
+            os.path.dirname(os.path.abspath(module.path)), "conftest.py"
+        )
+        if module.basename in _grandfathered(conftest):
+            return
+        if module.directives.file_budget:
+            return
+        if _module_pytestmark_slow(module.tree):
+            return
+
+        class_slow: dict = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                slow = _has_slow_mark(node) or any(
+                    isinstance(s, ast.Assign)
+                    and any(
+                        isinstance(t, ast.Name) and t.id == "pytestmark"
+                        for t in s.targets
+                    )
+                    and "slow" in ast.dump(s.value)
+                    for s in node.body
+                )
+                for sub in node.body:
+                    if isinstance(sub, ast.FunctionDef):
+                        class_slow[sub] = slow
+
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            if not node.name.startswith("test_"):
+                continue
+            if _has_slow_mark(node) or class_slow.get(node, False):
+                continue
+            if module.directive_near_def(node, "budget"):
+                continue
+            yield self.finding(
+                module, node,
+                f"{node.name} in a non-grandfathered test file has "
+                "neither @pytest.mark.slow nor a budget annotation "
+                "(`# smklint: budget=<why it fits the 60 s tier-1 "
+                "per-test budget>`, or one module-level `# smklint: "
+                "test-budget=...` covering the file)",
+            )
+
+
+# ---------------------------------------------------------------------------
+# SMK107 — unused module-level imports (ruff F401 backstop)
+# ---------------------------------------------------------------------------
+
+
+class UnusedImportRule(Rule):
+    id = "SMK107"
+    name = "unused-import"
+    doc = (
+        "module-level imports that no code in the file references — "
+        "the in-repo backstop for ruff's F401 so the scripts/lint.py "
+        "gate has teeth in environments (like this container) where "
+        "ruff is not installed. __init__.py re-exports and "
+        "try/except availability probes are exempt."
+    )
+
+    def applies(self, module):
+        return module.basename != "__init__.py"
+
+    def check(self, module, ctx):
+        bindings = []  # (name, line, rendered)
+        for stmt in module.tree.body:
+            if isinstance(stmt, ast.Import):
+                for a in stmt.names:
+                    if a.asname:
+                        bindings.append((a.asname, stmt.lineno, a.name))
+                    else:
+                        bindings.append((
+                            a.name.split(".")[0], stmt.lineno, a.name,
+                        ))
+            elif isinstance(stmt, ast.ImportFrom):
+                if stmt.module == "__future__":
+                    continue
+                for a in stmt.names:
+                    if a.name == "*":
+                        continue
+                    bindings.append((
+                        a.asname or a.name, stmt.lineno,
+                        f"{stmt.module or ''}.{a.name}",
+                    ))
+        if not bindings:
+            return
+        used: Set[str] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Name):
+                used.add(node.id)
+            # __all__ = ["name", ...] counts as use (re-export)
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == "__all__":
+                        for sub in ast.walk(node.value):
+                            if isinstance(sub, ast.Constant) and isinstance(
+                                sub.value, str
+                            ):
+                                used.add(sub.value)
+            # string annotations / forward refs
+            if isinstance(node, ast.Constant) and isinstance(
+                node.value, str
+            ) and len(node.value) < 120:
+                used.update(
+                    part for part in re.findall(r"\w+", node.value)
+                )
+        for name, line, rendered in bindings:
+            if name not in used:
+                yield Finding(
+                    self.id, module.path, line,
+                    f"[{self.name}] {rendered!r} (bound as {name!r}) "
+                    "is imported but never used in this module",
+                )
+
+
+ALL_RULES = [
+    BatchingRuleRule(),
+    HostNondeterminismRule(),
+    HostSyncInTracedRule(),
+    DonationDisciplineRule(),
+    PinnedProgramRule(),
+    TestBudgetRule(),
+    UnusedImportRule(),
+]

@@ -1,0 +1,256 @@
+"""Runtime sanitizers — smklint's layer 2 (ISSUE 6).
+
+Two context managers turn hot-path invariants from conventions into
+checks that fail loudly:
+
+- :func:`recompile_guard` counts XLA backend compilations via
+  ``jax.monitoring``'s ``/jax/core/compile/backend_compile_duration``
+  event and raises :class:`RecompileError` when a declared-stable hot
+  path compiles more than its budget — recompile churn is ROADMAP
+  open item 3's central cost (compile_s=120.4 > fit_s=70.1 on the
+  public path), and the shape-bucketed chunk-program cache
+  (parallel/recovery.py) is regression-tested with exactly this guard.
+- :func:`transfer_guard_strict` arms ``jax.transfer_guard`` and opens
+  a sanctioned-transfer ledger: every deliberate device→host fetch on
+  the chunk hot path (the ``HostSnapshot`` async copies, the K+4-byte
+  ``_chunk_stats`` guard fetch, checkpoint materialization) runs
+  under :func:`explicit_d2h` and is recorded with a tag; anything
+  else is an *implicit* transfer the jax guard rejects.
+
+CPU caveat (why the ledger exists at all): on the CPU backend,
+device buffers are host-resident, so jax's device-to-host guard never
+fires — ``np.asarray`` of a committed CPU array is a memcpy, not a
+transfer. The ledger is therefore the CPU-testable half of the
+contract (the overlap smoke test asserts the *exact* tag set and the
+guard-fetch byte count), while the armed jax guard is the accelerator
+half that makes an unsanctioned fetch a hard error on TPU/GPU.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import jax
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+# One process-wide monotone compile counter fed by a single listener:
+# jax.monitoring has no public unregister, so the listener registers
+# once and guards read deltas. The lock is for the counter only —
+# compilation happens on the dispatching thread, but nothing stops
+# two guards from overlapping across threads.
+_compile_lock = threading.Lock()
+_compile_count = 0
+_listener_registered = False
+
+
+def _on_event_duration(event: str, duration: float, **kwargs) -> None:
+    global _compile_count
+    if event == _COMPILE_EVENT:
+        with _compile_lock:
+            _compile_count += 1
+
+
+def _ensure_listener() -> None:
+    global _listener_registered
+    with _compile_lock:
+        if _listener_registered:
+            return
+        # register INSIDE the lock: a second thread's guard must not
+        # proceed (and miss compiles) between the flag flip and the
+        # actual registration
+        jax.monitoring.register_event_duration_secs_listener(
+            _on_event_duration
+        )
+        _listener_registered = True
+
+
+def compile_count() -> int:
+    """Process-wide count of XLA backend compilations observed since
+    the listener was installed (monotone; guards read deltas)."""
+    _ensure_listener()
+    with _compile_lock:
+        return _compile_count
+
+
+class RecompileError(RuntimeError):
+    """A declared-stable hot path triggered more XLA backend
+    compilations than its budget allows."""
+
+    def __init__(self, label: str, compiles: int, max_compiles: int):
+        self.label = label
+        self.compiles = compiles
+        self.max_compiles = max_compiles
+        super().__init__(
+            f"{label}: {compiles} XLA backend compilation(s) observed "
+            f"but at most {max_compiles} allowed — a shape/dtype/"
+            "static-arg perturbation is defeating the compiled-program "
+            "cache (ROADMAP open item 3: compile churn costs more "
+            "than the fit on the public path); bucket the shapes or "
+            "widen the declared budget deliberately"
+        )
+
+
+class RecompileGuard:
+    """Handle yielded by :func:`recompile_guard` — live compile
+    telemetry for the enclosed region."""
+
+    def __init__(self, label: str, max_compiles: int):
+        self.label = label
+        self.max_compiles = max_compiles
+        self._start = compile_count()
+
+    @property
+    def compiles(self) -> int:
+        return compile_count() - self._start
+
+    def check(self) -> int:
+        """Raise now (not at exit) if the budget is already blown;
+        returns the current count otherwise."""
+        n = self.compiles
+        if n > self.max_compiles:
+            raise RecompileError(self.label, n, self.max_compiles)
+        return n
+
+
+@contextmanager
+def recompile_guard(
+    max_compiles: int = 0, label: str = "declared-stable hot path"
+):
+    """Fail if the enclosed region triggers more than ``max_compiles``
+    XLA backend compilations.
+
+    ``max_compiles=0`` (default) declares the region fully warm: any
+    compile is a regression. Counting is process-wide (the jax
+    monitoring event carries no thread identity), so don't run two
+    compiling workloads concurrently under separate guards and expect
+    per-guard attribution.
+    """
+    _ensure_listener()
+    guard = RecompileGuard(label, max_compiles)
+    yield guard
+    guard.check()
+
+
+# ---------------------------------------------------------------------------
+# transfer discipline
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TransferLedger:
+    """Sanctioned-transfer record for one strict region: (tag, nbytes)
+    per :func:`explicit_d2h`/:func:`explicit_h2d` entry. ``nbytes`` is
+    the caller's accounting (e.g. ``HostSnapshot.nbytes``), -1 when
+    unknown."""
+
+    entries: List[Tuple[str, int]] = field(default_factory=list)
+    _lock: threading.Lock = field(
+        default_factory=threading.Lock, repr=False
+    )
+
+    def record(self, tag: str, nbytes: int) -> None:
+        with self._lock:
+            self.entries.append((tag, nbytes))
+
+    @property
+    def tags(self):
+        with self._lock:
+            return {t for t, _ in self.entries}
+
+    def bytes_for(self, tag: str) -> int:
+        with self._lock:
+            return sum(n for t, n in self.entries if t == tag and n > 0)
+
+    def count(self, tag: str) -> int:
+        with self._lock:
+            return sum(1 for t, _ in self.entries if t == tag)
+
+
+# Sanctioned sites run on both the caller thread and the background
+# checkpoint writer thread, and the overlap pipeline interleaves them
+# — so the active ledger is process-global (not thread-local), guarded
+# by its own lock. Strictness itself is also process-global: jax's
+# transfer-guard config is a context manager on the calling thread,
+# but the ledger must see every thread's sanctioned fetches.
+_active_ledger_lock = threading.Lock()
+_active_ledger: Optional[TransferLedger] = None
+
+
+def _current_ledger() -> Optional[TransferLedger]:
+    with _active_ledger_lock:
+        return _active_ledger
+
+
+@contextmanager
+def explicit_d2h(tag: str, nbytes: int = -1):
+    """Declare the enclosed device→host fetch sanctioned.
+
+    Inside :func:`transfer_guard_strict` this records (tag, nbytes)
+    into the ledger and relaxes jax's device-to-host guard for the
+    scope (the fetch is *explicit* by declaration); outside a strict
+    region it is a no-op — a guard level the caller armed directly
+    (without the ledger) is respected, not silently downgraded.
+    """
+    ledger = _current_ledger()
+    if ledger is None:
+        yield
+        return
+    ledger.record(tag, nbytes)
+    with jax.transfer_guard_device_to_host("allow"):
+        yield
+
+
+@contextmanager
+def explicit_h2d(tag: str, nbytes: int = -1):
+    """Host→device counterpart of :func:`explicit_d2h` (resume paths
+    feeding checkpointed numpy back to the device)."""
+    ledger = _current_ledger()
+    if ledger is None:
+        yield
+        return
+    ledger.record(tag, nbytes)
+    with jax.transfer_guard_host_to_device("allow"):
+        yield
+
+
+@contextmanager
+def transfer_guard_strict(
+    d2h: str = "disallow", h2d: str = "disallow"
+):
+    """Pin that the enclosed region performs only *explicit* device
+    transfers.
+
+    Arms ``jax.transfer_guard_device_to_host(d2h)`` and
+    ``jax.transfer_guard_host_to_device(h2d)`` (pass ``"allow"`` /
+    ``"log"`` to relax a direction) and yields a
+    :class:`TransferLedger` that every :func:`explicit_d2h` /
+    :func:`explicit_h2d` site records into. On accelerators an
+    unsanctioned implicit transfer raises inside jax; on CPU the
+    device-to-host direction cannot fire (host-resident buffers — see
+    module docstring), so assert on the ledger's tags/bytes instead.
+
+    Python scalars reaching jit boundaries are h2d transfers under
+    ``"disallow"`` — the chunk hot path ships its index scalars via
+    explicit ``jax.device_put`` for exactly this reason
+    (parallel/recovery.py, parallel/executor.py).
+
+    Not reentrant across concurrent regions: one ledger is active at
+    a time (process-global so the background checkpoint writer's
+    sanctioned fetches are ledgered too).
+    """
+    global _active_ledger
+    ledger = TransferLedger()
+    with _active_ledger_lock:
+        prev = _active_ledger
+        _active_ledger = ledger
+    try:
+        with jax.transfer_guard_device_to_host(d2h), \
+                jax.transfer_guard_host_to_device(h2d):
+            yield ledger
+    finally:
+        with _active_ledger_lock:
+            _active_ledger = prev

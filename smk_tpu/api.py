@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from smk_tpu.config import SMKConfig
-from smk_tpu.models.probit_gp import SpatialGPSampler, SubsetResult, n_params
+from smk_tpu.models.probit_gp import SpatialGPSampler, SubsetResult
 from smk_tpu.ops.glm import glm_warm_start
 from smk_tpu.ops.quantiles import (
     credible_summary,

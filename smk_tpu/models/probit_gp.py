@@ -53,7 +53,6 @@ Updates per iteration:
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple, Optional
 
 import jax

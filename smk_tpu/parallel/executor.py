@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from smk_tpu.analysis.sanitizers import explicit_d2h
 from smk_tpu.models.probit_gp import SpatialGPSampler, SubsetData, SubsetResult
 from smk_tpu.parallel.partition import Partition
 
@@ -99,7 +100,13 @@ def write_draws(
     in-place-shaped) update, the documented measured-negative in
     FUSED_BUILD_r07.jsonl. ``offset`` must be a traced/weak scalar so
     chunks of equal length share one compile."""
-    offset = jnp.asarray(offset, jnp.int32)
+    if isinstance(offset, jax.Array):
+        offset = jnp.asarray(offset, jnp.int32)
+    else:
+        # explicit H2D for the host-side int: same strong-int32 aval
+        # as jnp.asarray(offset, jnp.int32), but device_put keeps the
+        # chunk hot loop clean under transfer_guard_strict
+        offset = jax.device_put(np.asarray(offset, np.int32))
     if _backend_supports_donation():
         return _write_draws_donated(acc, new, offset)
     return _write_draws_plain(acc, new, offset)
@@ -164,8 +171,13 @@ class HostSnapshot:
 
     def get(self):
         """The snapshot as a numpy pytree (blocks if copies are still
-        in flight)."""
-        return jax.tree_util.tree_map(np.asarray, self._tree)
+        in flight). The materialization is a SANCTIONED device→host
+        fetch: under analysis/sanitizers.transfer_guard_strict it is
+        ledgered by tag and allowed through the armed jax guard —
+        HostSnapshot copies are exactly the explicit D2H the overlap
+        pipeline's transfer contract permits."""
+        with explicit_d2h("host_snapshot", nbytes=self.nbytes):
+            return jax.tree_util.tree_map(np.asarray, self._tree)
 
 
 def stacked_subset_data(

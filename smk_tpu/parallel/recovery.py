@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from smk_tpu.analysis.sanitizers import explicit_d2h
 from smk_tpu.models.probit_gp import (
     SpatialGPSampler,
     SubsetData,
@@ -101,6 +102,7 @@ class SubsetNaNError(RuntimeError):
         )
 
 
+# smklint: pinned-program (bit-identity: guard stays outside the chunk module)
 @jax.jit
 def _finite_subsets(state) -> jnp.ndarray:
     """(K,) bool: every small carried leaf finite per subset. chol_r
@@ -113,6 +115,8 @@ def _finite_subsets(state) -> jnp.ndarray:
     return jnp.stack(oks).all(axis=0)
 
 
+# smklint: pinned-program (fusing this fetch into the chunk program breaks
+# the cross-mode bit-identity contract — see docstring)
 @jax.jit
 def _chunk_stats(state):
     """Device-side guard + report statistics for one chunk boundary:
@@ -196,10 +200,11 @@ def _leaf_fingerprint(leaf) -> int:
         )
     else:  # 1-byte dtypes (bool/int8): the value determines the bits
         bits = arr.astype(jnp.uint32)
-    h = zlib.crc32(np.asarray(_leaf_checksum(bits)).tobytes(), h)
-    stride = max(1, n // _IDENT_SAMPLE)
-    sample = np.asarray(arr[::stride][:_IDENT_SAMPLE])
-    return zlib.crc32(np.ascontiguousarray(sample).tobytes(), h)
+    with explicit_d2h("run_identity"):
+        h = zlib.crc32(np.asarray(_leaf_checksum(bits)).tobytes(), h)
+        stride = max(1, n // _IDENT_SAMPLE)
+        sample = np.asarray(arr[::stride][:_IDENT_SAMPLE])
+        return zlib.crc32(np.ascontiguousarray(sample).tobytes(), h)
 
 
 def _run_identity(cfg, key, data, beta_init) -> np.ndarray:
@@ -228,6 +233,16 @@ def _run_identity(cfg, key, data, beta_init) -> np.ndarray:
 
 
 _init_states = init_subset_states  # backwards-compatible alias
+
+
+def _fetch_draws_slice(param_draws, w_draws, filled):
+    """Sanctioned full fetch of the filled draws region — only the
+    degraded-writer recovery and resume-time compaction pay it."""
+    with explicit_d2h("checkpoint_full_rewrite"):
+        return (
+            np.asarray(param_draws[..., :filled, :]),
+            np.asarray(w_draws[..., :filled, :]),
+        )
 
 
 def _make_chunk_fn(model, kind, length, k, chunk_size):
@@ -274,6 +289,38 @@ def _make_chunk_fn(model, kind, length, k, chunk_size):
         )
 
     return jax.jit(chunked, donate_argnums=(1,))
+
+
+_CHUNK_PROGRAM_CACHE_MAX = 32  # buckets per model (see _cached_program)
+
+
+def _cached_program(model, key, build):
+    """Compiled chunk programs cached ON the model instance, keyed by
+    (kind, length, K, chunk_size). _make_chunk_fn builds FRESH lambdas,
+    so without this cache every fit_subsets_chunked call re-jits (and
+    XLA re-compiles) programs byte-identical to the previous call's —
+    the recompile churn ROADMAP open item 3 prices at more than the
+    fit itself on the public path. With it, two same-shape-bucket
+    calls on one model share one compile (regression-tested under
+    analysis/sanitizers.recompile_guard in tests/test_sanitizers.py).
+
+    Instance storage (not a module-level weak map) because the cached
+    jit closures hold the model strongly — a WeakKeyDictionary whose
+    values reference their key never collects; this way the
+    executables die with the model. Sound because everything a chunk
+    program closes over is frozen at model construction (SMKConfig is
+    a frozen dataclass; weight/fused_build resolve in __init__).
+    Bounded FIFO: a model driven through a sweep of buckets (varying
+    chunk_iters/K) must not accumulate multi-MB XLA executables
+    forever — a normal run touches <= 3 buckets (burn chunk, sampling
+    chunk, finalize), so evictions only happen under sweeps, where
+    re-compiling a dropped bucket is the status quo ante."""
+    per_model = model.__dict__.setdefault("_chunk_programs", {})
+    if key not in per_model:
+        while len(per_model) >= _CHUNK_PROGRAM_CACHE_MAX:
+            per_model.pop(next(iter(per_model)))
+        per_model[key] = build()
+    return per_model[key]
 
 
 def _read_segments(path, seg_base, n_segments, filled, dtype):
@@ -736,9 +783,8 @@ def fit_subsets_chunked(
             # live-accumulator access for the degraded/compaction
             # full rewrite: regions beyond `filled` are never read,
             # so later in-flight chunk writes can't corrupt the slice
-            full_draws=lambda filled: (
-                np.asarray(param_draws[..., :filled, :]),
-                np.asarray(w_draws[..., :filled, :]),
+            full_draws=lambda filled: _fetch_draws_slice(
+                param_draws, w_draws, filled
             ),
         )
 
@@ -816,14 +862,11 @@ def fit_subsets_chunked(
         param_draws, w_draws = empty_draws()
         it = 0
 
-    chunk_fns = {}
-
     def chunk_fn(kind: str, n: int):
-        if (kind, n) not in chunk_fns:
-            chunk_fns[kind, n] = _make_chunk_fn(
-                model, kind, n, k, chunk_size
-            )
-        return chunk_fns[kind, n]
+        return _cached_program(
+            model, (kind, n, k, chunk_size),
+            lambda: _make_chunk_fn(model, kind, n, k, chunk_size),
+        )
 
     n_burn = cfg.n_burn_in
     want_stats = nan_guard or progress is not None
@@ -893,11 +936,15 @@ def fit_subsets_chunked(
     def dispatch(kind, start, n):
         """Issue one chunk's device work; returns the new carry."""
         nonlocal state, param_draws, w_draws, it
+        # device_put (not jnp.asarray) keeps this scalar feed an
+        # EXPLICIT transfer under transfer_guard_strict; both produce
+        # the same weak-int32 aval, so the chunk program is unchanged
+        start_dev = jax.device_put(start)
         if kind == "burn":
-            state = chunk_fn("burn", n)(data, state, jnp.asarray(start))
+            state = chunk_fn("burn", n)(data, state, start_dev)
         else:
             state, (pd, wd) = chunk_fn("samp", n)(
-                data, state, jnp.asarray(start)
+                data, state, start_dev
             )
             # draws land at [start - n_burn, start - n_burn + n) on
             # the iteration axis of the PREALLOCATED accumulators —
@@ -923,8 +970,11 @@ def fit_subsets_chunked(
         """
         t0 = time.perf_counter()
         if b["stats"] is not None:
-            finite = np.asarray(b["stats"][0])
-            accept = float(np.asarray(b["stats"][1]))
+            # the ONE sanctioned guard/report fetch per boundary —
+            # K+4 bytes, declared to transfer_guard_strict
+            with explicit_d2h("chunk_stats", nbytes=stats_bytes):
+                finite = np.asarray(b["stats"][0])
+                accept = float(np.asarray(b["stats"][1]))
             if nan_guard and not finite.all():
                 if ck is not None and writer is not None:
                     # earlier checkpoints must land before the raise:
@@ -957,6 +1007,7 @@ def fit_subsets_chunked(
         stats = _chunk_stats(state) if want_stats else None
         if stats is not None and mode == "overlap":
             for leaf in stats:
+                # smklint: disable=SMK104 -- stats are fresh outputs of the _chunk_stats jit (never donated); getattr probes for numpy leaves on resume paths
                 start_copy = getattr(leaf, "copy_to_host_async", None)
                 if start_copy is not None:
                     start_copy()
@@ -1046,7 +1097,10 @@ def fit_subsets_chunked(
     if truncated and it < cfg.n_samples:
         return None
 
-    finalize = jax.jit(jax.vmap(model.finalize))
+    finalize = _cached_program(
+        model, ("finalize",),
+        lambda: jax.jit(jax.vmap(model.finalize)),
+    )
     return finalize(state, param_draws, w_draws)
 
 

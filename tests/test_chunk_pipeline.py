@@ -12,6 +12,7 @@ in scripts/async_pipe_probe.py (ASYNC_PIPE_r08.jsonl) and the bench
 chunk_pipeline_ab cell, not here.
 """
 
+# smklint: test-budget=tiny m=16 problems, each fit a few seconds on CPU (measured well under the 60 s conftest gate this file is already enforced by)
 import dataclasses
 import os
 import warnings
@@ -153,6 +154,10 @@ class TestSyncOverlapParity:
         with pytest.raises(ValueError, match="segNNNNN"):
             run(problem, "sync", path)
 
+    # slow-marked r9: 22 s measured — the main kill/resume leg
+    # above keeps the resume contract in-gate; this is the
+    # compaction crash-window edge case
+    @pytest.mark.slow
     def test_compaction_crash_window_is_safe(self, problem, tmp_path):
         """Resume-time compaction merges N>1 segments — its merged
         segment must land at a FRESH index, so a kill between that

@@ -15,6 +15,7 @@ contracts against the naive per-pair form:
    depends on it).
 """
 
+# smklint: test-budget=pure-ops shape tests on <=64-point arrays, milliseconds each
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -21,8 +21,6 @@ the tier-1 gate covers the engine indirectly through every sampler
 test, which now runs the reuse path by default.
 """
 
-import dataclasses
-
 import jax
 import jax.numpy as jnp
 import numpy as np

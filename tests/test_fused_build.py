@@ -19,6 +19,7 @@ Sampler-level tests compile full programs and are slow-marked; the
 kernel parity tests are tier-1.
 """
 
+# smklint: test-budget=unmarked tests are interpret-mode kernel parities on tiny tiles; the sampler-level legs are slow-marked
 import hashlib
 import warnings
 

@@ -19,8 +19,6 @@ Three guarantees from the acceptance criteria:
    plain J=1 chain (moment check on the phi draws; slow-marked).
 """
 
-import dataclasses
-
 import jax
 import jax.numpy as jnp
 import numpy as np

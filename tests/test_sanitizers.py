@@ -1,0 +1,214 @@
+"""Runtime-sanitizer tests (ISSUE 6, smklint layer 2).
+
+- transfer_guard_strict smoke test around a full chunk_pipeline=
+  "overlap" run: the ONLY device→host fetches are the sanctioned,
+  ledgered ones — the HostSnapshot async copies, the K+4-byte
+  _chunk_stats guard fetch, and the one-time run-identity fingerprint
+  — with jax's own transfer guard armed throughout (proven armed by a
+  scalar-transfer tripwire).
+- recompile_guard regression: two same-shape-bucket
+  fit_subsets_chunked calls on one model share compiled chunk
+  programs (second call: ZERO XLA backend compiles — the
+  recovery._cached_program contract); a shape-perturbed call is
+  caught as RecompileError (acceptance seeded-defect #3).
+
+Sizes mirror tests/test_chunk_pipeline.py (m=16; 12 iterations —
+compile cost dominates these fits, so the iteration count is the
+minimum that exercises one burn and one sampling boundary).
+"""
+
+# smklint: test-budget=tiny m=16 fits shared through one module-scoped warm model; each test measured a few seconds on CPU
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from smk_tpu.analysis.sanitizers import (
+    RecompileError,
+    TransferLedger,
+    compile_count,
+    explicit_d2h,
+    recompile_guard,
+    transfer_guard_strict,
+)
+from smk_tpu.config import SMKConfig
+from smk_tpu.models.probit_gp import SpatialProbitGP
+from smk_tpu.parallel.partition import random_partition
+from smk_tpu.parallel.recovery import fit_subsets_chunked
+
+CFG = SMKConfig(
+    n_subsets=4, n_samples=12, burn_in_frac=0.5, phi_update_every=2,
+    chunk_pipeline="overlap",
+)
+K = 4
+N_CHUNKS = 2  # 12 iterations / chunk_iters=6 (1 burn + 1 sampling)
+
+
+@pytest.fixture(scope="module")
+def problem():
+    rng = np.random.default_rng(7)
+    n, q, p, t = 64, 1, 2, 3
+    coords = jnp.asarray(rng.uniform(size=(n, 2)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(n, q, p)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, size=(n, q)), jnp.float32)
+    ct = jnp.asarray(rng.uniform(size=(t, 2)), jnp.float32)
+    xt = jnp.asarray(rng.normal(size=(t, q, p)), jnp.float32)
+    part = random_partition(jax.random.key(0), y, x, coords, K)
+    return part, ct, xt, jax.random.key(1)
+
+
+@pytest.fixture(scope="module")
+def model():
+    """ONE model for the whole module: the chunk-program cache is
+    keyed by model instance, so every test after the first runs its
+    fits compile-free — that sharing is itself part of what the
+    recompile tests pin down."""
+    return SpatialProbitGP(CFG, weight=1)
+
+
+def run(model, problem, path=None, **kw):
+    part, ct, xt, key = problem
+    return fit_subsets_chunked(
+        model, part, ct, xt, key,
+        chunk_iters=6, checkpoint_path=path, **kw,
+    )
+
+
+class TestTransferGuardStrict:
+    def test_overlap_step_is_d2h_explicit_only(
+        self, model, problem, tmp_path
+    ):
+        """The satellite contract: a checkpointed overlap run under
+        the strict guard performs ONLY the sanctioned D2H fetches —
+        exact tag set, exact guard-fetch byte count — and produces
+        bit-identical draws to an unguarded run (the guard observes,
+        never perturbs)."""
+        ref = run(model, problem)
+        path = str(tmp_path / "ck.npz")
+        with transfer_guard_strict(h2d="allow") as ledger:
+            res = run(model, problem, path=path, nan_guard=True)
+        assert ledger.tags == {
+            "host_snapshot", "chunk_stats", "run_identity"
+        }
+        # one K+4-byte guard/report fetch per chunk boundary
+        assert ledger.count("chunk_stats") == N_CHUNKS
+        assert ledger.bytes_for("chunk_stats") == N_CHUNKS * (K + 4)
+        # one state snapshot per boundary + one draws snapshot per
+        # sampling chunk (1 burn + 1 sampling at these sizes)
+        assert ledger.count("host_snapshot") == N_CHUNKS + 1
+        assert ledger.bytes_for("host_snapshot") > 0
+        assert os.path.exists(path)
+        np.testing.assert_array_equal(
+            np.asarray(ref.param_samples), np.asarray(res.param_samples)
+        )
+
+    def test_guard_is_armed_inside_the_region(self):
+        """Passing the smoke test must mean something: inside the
+        strict region an UNsanctioned implicit transfer raises (on
+        CPU the h2d direction is the live tripwire — d2h cannot fire
+        against host-resident buffers, which is exactly why the
+        ledger assertions above exist; see sanitizers docstring)."""
+        with transfer_guard_strict():
+            with pytest.raises(Exception, match="[Dd]isallow"):
+                jnp.asarray(1.0)  # implicit scalar h2d
+            # explicit transfers stay legal under "disallow"
+            x = jax.device_put(np.float32(1.0))
+        assert float(np.asarray(x)) == 1.0  # guard restored on exit
+
+    def test_explicit_d2h_ledgers_only_when_strict(self):
+        x = jax.device_put(np.arange(3, dtype=np.float32))
+        with explicit_d2h("outside", nbytes=12):
+            np.asarray(x)  # no active ledger: free, unrecorded
+        with transfer_guard_strict(h2d="allow") as ledger:
+            with explicit_d2h("inside", nbytes=12):
+                np.asarray(x)
+        assert ledger.entries == [("inside", 12)]
+        assert ledger.bytes_for("inside") == 12
+        assert ledger.count("outside") == 0
+
+    def test_explicit_scope_respects_user_armed_guard(self):
+        """Outside a strict region the explicit_* helpers are no-ops:
+        a guard level the user armed directly must not be silently
+        downgraded to "allow" by the library's sanctioned sites."""
+        from smk_tpu.analysis.sanitizers import explicit_h2d
+
+        with jax.transfer_guard_host_to_device("disallow"):
+            with explicit_h2d("library_site"):
+                with pytest.raises(Exception, match="[Dd]isallow"):
+                    jnp.asarray(2.0)  # still blocked: no ledger
+        # ... while inside transfer_guard_strict the same site passes
+        with transfer_guard_strict(d2h="allow") as ledger:
+            with explicit_h2d("library_site"):
+                jnp.asarray(2.0)
+        assert ledger.count("library_site") == 1
+
+    def test_ledger_units(self):
+        led = TransferLedger()
+        led.record("a", 10)
+        led.record("a", -1)  # unknown size: counted, not summed
+        led.record("b", 5)
+        assert led.tags == {"a", "b"}
+        assert led.count("a") == 2
+        assert led.bytes_for("a") == 10
+        assert led.bytes_for("b") == 5
+
+
+class TestRecompileGuard:
+    def test_same_shape_bucket_refit_compiles_nothing(
+        self, model, problem
+    ):
+        """ROADMAP item 3 regression: with the per-model chunk-program
+        cache, a second fit in the same (m, K, q, chunk) shape bucket
+        on the same model issues ZERO XLA backend compiles — the whole
+        MCMC re-runs on cached executables. (The first call in this
+        module paid the one compile per program; asserting 0 here is
+        the 'exactly one compile across two calls' satellite, stated
+        per program.)"""
+        run(model, problem)  # warm (no-op if an earlier test warmed)
+        before = compile_count()
+        with recompile_guard(label="same-bucket refit") as guard:
+            res = run(model, problem)
+        assert guard.compiles == 0
+        assert compile_count() == before
+        assert res is not None
+
+    def test_shape_perturbed_call_is_caught(self, model, problem):
+        """Acceptance seeded-defect #3: perturbing the chunk-program
+        shape (m 16 -> 12 via a smaller n) under the guard raises
+        RecompileError instead of silently paying the recompile."""
+        rng = np.random.default_rng(3)
+        n, q, p, t = 48, 1, 2, 3
+        coords = jnp.asarray(rng.uniform(size=(n, 2)), jnp.float32)
+        x = jnp.asarray(rng.normal(size=(n, q, p)), jnp.float32)
+        y = jnp.asarray(rng.integers(0, 2, size=(n, q)), jnp.float32)
+        part = random_partition(jax.random.key(0), y, x, coords, K)
+        _, ct, xt, key = problem
+        with pytest.raises(RecompileError) as ei:
+            with recompile_guard(label="perturbed bucket"):
+                # one chunk is enough to force the fresh-bucket
+                # compile the guard must catch (keeps the tier-1
+                # window cost down)
+                fit_subsets_chunked(
+                    model, part, ct, xt, key, chunk_iters=6,
+                    stop_after_chunks=1,
+                )
+        assert ei.value.compiles > 0
+        assert "perturbed bucket" in str(ei.value)
+
+    def test_budget_and_check(self, model, problem):
+        """max_compiles is a budget, not a toggle: an in-budget region
+        passes, and .check() raises mid-region once blown."""
+        run(model, problem)  # warm outside the guard (order-proof)
+        with recompile_guard(max_compiles=2, label="budgeted") as g:
+            run(model, problem)  # warm model: 0 compiles
+            assert g.check() == 0
+        g2 = None
+        with pytest.raises(RecompileError):
+            with recompile_guard(max_compiles=0, label="strict") as g2:
+                jax.jit(lambda v: v * jnp.float32(3.5))(
+                    jnp.arange(5, dtype=jnp.float32)
+                )
+        assert g2.compiles >= 1

@@ -1,0 +1,547 @@
+"""smklint engine + rule tests (ISSUE 6): per-rule positive/negative
+fixtures, suppression-comment handling, and the seeded-defect checks
+the acceptance criteria name — removing the optimization_barrier
+batching-rule registration from the REAL probit_gp.py source and
+injecting an .item() into the REAL Gibbs scan body must both be
+caught. Also the tree-wide gate: the repo itself lints clean.
+
+All pure-AST work on strings — no jax tracing, milliseconds per test.
+"""
+
+# smklint: test-budget=pure stdlib AST analysis on in-memory fixtures; the tree-wide sweep measures ~3 s
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from smk_tpu.analysis.engine import lint_paths, lint_source
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def repo_file(rel):
+    return open(os.path.join(REPO, rel)).read()
+
+MODELS_PATH = "smk_tpu/models/fixture.py"
+OPS_PATH = "smk_tpu/ops/fixture.py"
+DATA_PATH = "smk_tpu/data/fixture.py"
+TESTS_PATH = "tests/test_fixture_virtual.py"
+SCRIPT_PATH = "scripts/fixture.py"
+
+
+def rules_hit(src, path=MODELS_PATH, **kw):
+    return [f.rule for f in lint_source(src, path=path, **kw)]
+
+
+def lines_hit(src, rule, path=MODELS_PATH, **kw):
+    return [
+        f.line for f in lint_source(src, path=path, **kw)
+        if f.rule == rule
+    ]
+
+
+class TestBatchingRule:
+    def test_unregistered_known_primitive_flagged(self):
+        src = (
+            "import jax\n"
+            "from jax import lax\n"
+            "def f(x):\n"
+            "    return lax.optimization_barrier((x,))[0]\n"
+        )
+        assert "SMK101" in rules_hit(src)
+
+    def test_registered_in_module_passes(self):
+        src = (
+            "from jax import lax\n"
+            "from jax.interpreters import batching as _b\n"
+            "_p = lax.optimization_barrier_p\n"
+            "def _rule(args, dims):\n"
+            "    return _p.bind(*args), dims\n"
+            "_b.primitive_batchers[_p] = _rule\n"
+            "def f(x):\n"
+            "    return lax.optimization_barrier((x,))[0]\n"
+        )
+        assert "SMK101" not in rules_hit(src)
+
+    def test_in_tree_primitive_needs_registration(self):
+        src = (
+            "import jax\n"
+            "my_p = jax.core.Primitive('my_op')\n"
+        )
+        assert "SMK101" in rules_hit(src)
+        registered = src + (
+            "from jax.interpreters import batching\n"
+            "batching.primitive_batchers[my_p] = lambda a, d: (a, d)\n"
+        )
+        assert "SMK101" not in rules_hit(registered)
+
+    def test_real_probit_gp_clean_and_seeded_defect_caught(self):
+        """Acceptance seeded-defect #1: the shipped source passes;
+        deleting ONLY the registration assignment re-creates the PR 1
+        vmap crash class and smklint catches it."""
+        src = repo_file("smk_tpu/models/probit_gp.py")
+        real = "smk_tpu/models/probit_gp.py"
+        assert lint_source(src, path=real) == []
+        reg = "_batching.primitive_batchers[_ob_p] = _ob_batch_rule"
+        assert src.count(reg) == 1
+        broken = src.replace(reg, "pass")
+        assert "SMK101" in rules_hit(broken, path=real)
+
+
+class TestHostNondeterminism:
+    def test_np_random_in_sampler_zone_flagged(self):
+        src = "import numpy as np\nx = np.random.default_rng(0)\n"
+        assert "SMK102" in rules_hit(src, path=MODELS_PATH)
+        assert "SMK102" in rules_hit(src, path=OPS_PATH)
+
+    def test_seeded_default_rng_ok_in_data_zone(self):
+        src = "import numpy as np\nx = np.random.default_rng(7)\n"
+        assert "SMK102" not in rules_hit(src, path=DATA_PATH)
+
+    def test_unseeded_default_rng_flagged_everywhere(self):
+        src = "import numpy as np\nx = np.random.default_rng()\n"
+        assert "SMK102" in rules_hit(src, path=DATA_PATH)
+
+    def test_global_state_np_random_flagged_in_data_zone(self):
+        src = "import numpy as np\nnp.random.seed(0)\n"
+        assert "SMK102" in rules_hit(src, path=DATA_PATH)
+
+    def test_stdlib_random_flagged(self):
+        src = "import random\nx = random.random()\n"
+        assert "SMK102" in rules_hit(src, path=OPS_PATH)
+
+    def test_time_seeded_generator_flagged(self):
+        src = (
+            "import time\nimport numpy as np\n"
+            "rng = np.random.default_rng(int(time.time()))\n"
+        )
+        assert "SMK102" in rules_hit(src, path=DATA_PATH)
+
+    def test_jax_prng_is_fine(self):
+        src = (
+            "import jax\n"
+            "def draw(key):\n"
+            "    return jax.random.normal(key, (3,))\n"
+        )
+        assert "SMK102" not in rules_hit(src, path=MODELS_PATH)
+
+
+_SCAN_WRAP = (
+    "import jax\n"
+    "import jax.numpy as jnp\n"
+    "import numpy as np\n"
+    "from jax import lax\n"
+    "def step(carry, it):\n"
+    "{body}"
+    "    return carry, it\n"
+    "def run(x):\n"
+    "    return lax.scan(step, x, jnp.arange(4))\n"
+)
+
+
+class TestHostSyncInTraced:
+    def test_item_in_scan_body(self):
+        src = _SCAN_WRAP.format(body="    bad = carry.item()\n")
+        assert "SMK103" in rules_hit(src)
+
+    def test_np_asarray_in_scan_body(self):
+        src = _SCAN_WRAP.format(body="    bad = np.asarray(carry)\n")
+        assert "SMK103" in rules_hit(src)
+
+    def test_float_of_jax_expr_in_jitted_fn(self):
+        src = (
+            "import jax\nimport jax.numpy as jnp\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    return float(jnp.sum(x))\n"
+        )
+        assert "SMK103" in rules_hit(src)
+
+    def test_implicit_bool_branch_in_traced(self):
+        src = _SCAN_WRAP.format(
+            body="    if jnp.any(carry > 0):\n        carry = carry\n"
+        )
+        assert "SMK103" in rules_hit(src)
+
+    def test_block_until_ready_in_cond_branch(self):
+        src = (
+            "import jax\nfrom jax import lax\n"
+            "def t(x):\n"
+            "    return x.block_until_ready()\n"
+            "def f(p, x):\n"
+            "    return lax.cond(p, t, lambda y: y, x)\n"
+        )
+        assert "SMK103" in rules_hit(src)
+
+    def test_transitive_method_call_is_traced(self):
+        """The real bug shape: scan body -> self._step -> .item()."""
+        src = (
+            "import jax\nfrom jax import lax\n"
+            "import jax.numpy as jnp\n"
+            "class S:\n"
+            "    def _step(self, c):\n"
+            "        return c + c.item()\n"
+            "    def run(self, x):\n"
+            "        body = lambda c, i: (self._step(c), i)\n"
+            "        return lax.scan(body, x, jnp.arange(3))\n"
+        )
+        assert "SMK103" in rules_hit(src)
+
+    def test_host_level_sync_is_fine(self):
+        src = (
+            "import numpy as np\nimport jax.numpy as jnp\n"
+            "def fetch(x):\n"
+            "    return np.asarray(x), float(jnp.sum(x))\n"
+        )
+        assert "SMK103" not in rules_hit(src)
+
+    def test_static_shape_int_in_jit_is_fine(self):
+        src = (
+            "import jax\nimport jax.numpy as jnp\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    m = int(x.shape[0])\n"
+            "    return jnp.zeros((m,)) + x\n"
+        )
+        assert "SMK103" not in rules_hit(src)
+
+    def test_from_import_device_get_in_scan_body(self):
+        src = (
+            "import jax\nimport jax.numpy as jnp\n"
+            "from jax import device_get, lax\n"
+            "def step(c, i):\n"
+            "    return c, device_get(c)\n"
+            "def run(x):\n"
+            "    return lax.scan(step, x, jnp.arange(4))\n"
+        )
+        assert "SMK103" in rules_hit(src)
+
+    def test_real_gibbs_body_seeded_item_caught(self):
+        """Acceptance seeded-defect #2: an .item() injected into the
+        REAL _gibbs_step (reached from every lax.scan body) is
+        caught; the shipped source is clean (asserted above)."""
+        src = repo_file("smk_tpu/models/probit_gp.py")
+        anchor = (
+            "        beta, u, a, phi = "
+            "state.beta, state.u, state.a, state.phi"
+        )
+        assert src.count(anchor) == 1
+        bad = src.replace(
+            anchor, anchor + "\n        _dbg = phi.item()"
+        )
+        hits = lines_hit(
+            bad, "SMK103", path="smk_tpu/models/probit_gp.py"
+        )
+        assert len(hits) == 1
+
+
+class TestDonationDiscipline:
+    def test_read_after_donate_flagged(self):
+        src = (
+            "import jax\n"
+            "f = jax.jit(lambda a, b: a + b, donate_argnums=(0,))\n"
+            "def go(x, y):\n"
+            "    out = f(x, y)\n"
+            "    return out + x.mean()\n"
+        )
+        assert "SMK104" in rules_hit(src)
+
+    def test_rebind_from_result_is_fine(self):
+        src = (
+            "import jax\n"
+            "f = jax.jit(lambda a, b: a + b, donate_argnums=(0,))\n"
+            "def go(x, y):\n"
+            "    x = f(x, y)\n"
+            "    return x + 1\n"
+        )
+        assert "SMK104" not in rules_hit(src)
+
+    def test_return_branches_are_fine(self):
+        """The executor.write_draws shape: donate inside a return —
+        no read can follow in that branch."""
+        src = (
+            "import jax\n"
+            "fd = jax.jit(lambda a, b: a + b, donate_argnums=(0,))\n"
+            "fp = jax.jit(lambda a, b: a + b)\n"
+            "def go(x, y, donate):\n"
+            "    if donate:\n"
+            "        return fd(x, y)\n"
+            "    return fp(x, y)\n"
+        )
+        assert "SMK104" not in rules_hit(src)
+
+    def test_copy_without_clone_flagged(self):
+        src = (
+            "def snap(leaf):\n"
+            "    leaf.copy_to_host_async()\n"
+            "    return leaf\n"
+        )
+        assert "SMK104" in rules_hit(src)
+
+    def test_clone_then_copy_is_fine(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "def snap(leaf):\n"
+            "    leaf = jnp.copy(leaf)\n"
+            "    leaf.copy_to_host_async()\n"
+            "    return leaf\n"
+        )
+        assert "SMK104" not in rules_hit(src)
+
+    def test_getattr_copy_is_opaque_and_flagged(self):
+        src = (
+            "def snap(leaf):\n"
+            "    fn = getattr(leaf, 'copy_to_host_async', None)\n"
+            "    return fn\n"
+        )
+        assert "SMK104" in rules_hit(src)
+
+
+_PIN_SRC = (
+    "import jax\n"
+    "import jax.numpy as jnp\n"
+    "# smklint: pinned-program\n"
+    "@jax.jit\n"
+    "def _guard_stats(state):\n"
+    "    return jnp.mean(state)\n"
+)
+
+
+class TestPinnedProgram:
+    def test_pin_needs_test_reference(self):
+        assert "SMK105" in rules_hit(_PIN_SRC, tests_text="")
+        assert "SMK105" not in rules_hit(
+            _PIN_SRC, tests_text="uses _guard_stats somewhere"
+        )
+
+    def test_traced_call_of_pinned_flagged(self):
+        src = _PIN_SRC + (
+            "@jax.jit\n"
+            "def chunk(state):\n"
+            "    return _guard_stats(state) + 1\n"
+        )
+        assert "SMK105" in rules_hit(
+            src, tests_text="_guard_stats"
+        )
+
+    def test_pinned_handed_to_scan_flagged(self):
+        src = _PIN_SRC + (
+            "from jax import lax\n"
+            "def f(x):\n"
+            "    return lax.scan(_guard_stats, x, jnp.arange(2))\n"
+        )
+        assert "SMK105" in rules_hit(
+            src, tests_text="_guard_stats"
+        )
+
+    def test_host_call_of_pinned_is_fine(self):
+        src = _PIN_SRC + (
+            "def boundary(state):\n"
+            "    return _guard_stats(state)\n"
+        )
+        assert "SMK105" not in rules_hit(
+            src, tests_text="_guard_stats"
+        )
+
+
+class TestTestBudget:
+    def test_unmarked_test_in_new_file_flagged(self):
+        src = "def test_something():\n    assert True\n"
+        assert "SMK106" in rules_hit(src, path=TESTS_PATH)
+
+    def test_slow_mark_exempts(self):
+        src = (
+            "import pytest\n"
+            "@pytest.mark.slow\n"
+            "def test_something():\n"
+            "    assert True\n"
+        )
+        assert "SMK106" not in rules_hit(src, path=TESTS_PATH)
+
+    def test_per_test_budget_comment_exempts(self):
+        src = (
+            "# smklint: budget=pure python, milliseconds\n"
+            "def test_something():\n"
+            "    assert True\n"
+        )
+        assert "SMK106" not in rules_hit(src, path=TESTS_PATH)
+
+    def test_module_budget_comment_exempts(self):
+        src = (
+            "# smklint: test-budget=all host-side units\n"
+            "def test_something():\n"
+            "    assert True\n"
+        )
+        assert "SMK106" not in rules_hit(src, path=TESTS_PATH)
+
+    def test_grandfathered_file_exempts(self):
+        """conftest's SLOW_GATE_GRANDFATHERED is the shared source of
+        truth — a file named in it is exempt at its real path."""
+        src = "def test_something():\n    assert True\n"
+        assert "SMK106" not in rules_hit(src, path="tests/test_ops.py")
+
+    def test_non_test_module_out_of_scope(self):
+        src = "def test_something():\n    assert True\n"
+        assert "SMK106" not in rules_hit(src, path=OPS_PATH)
+
+
+class TestUnusedImport:
+    def test_unused_flagged_and_used_not(self):
+        src = "import os\nimport sys\nprint(sys.argv)\n"
+        hits = lines_hit(src, "SMK107", path=SCRIPT_PATH)
+        assert hits == [1]
+
+    def test_init_reexports_exempt(self):
+        src = "from smk_tpu.config import SMKConfig\n"
+        assert "SMK107" not in rules_hit(
+            src, path="smk_tpu/fake/__init__.py"
+        )
+
+    def test_try_probe_exempt(self):
+        src = (
+            "try:\n"
+            "    import fancy_backend\n"
+            "except ImportError:\n"
+            "    fancy_backend = None\n"
+        )
+        assert "SMK107" not in rules_hit(src, path=SCRIPT_PATH)
+
+    def test_all_counts_as_use(self):
+        src = "from smk_tpu.config import SMKConfig\n__all__ = ['SMKConfig']\n"
+        assert "SMK107" not in rules_hit(src, path=SCRIPT_PATH)
+
+
+_VIOLATION = (
+    "import numpy as np\n"
+    "x = np.random.default_rng()\n"
+)
+
+
+class TestSuppressions:
+    def test_justified_line_disable_suppresses(self):
+        src = (
+            "import numpy as np\n"
+            "# smklint: disable=SMK102 -- entropy wanted here, off the fit path\n"
+            "x = np.random.default_rng()\n"
+        )
+        assert rules_hit(src, path=DATA_PATH) == []
+
+    def test_same_line_disable_suppresses(self):
+        src = (
+            "import numpy as np\n"
+            "x = np.random.default_rng()  "
+            "# smklint: disable=SMK102 -- deliberate\n"
+        )
+        assert rules_hit(src, path=DATA_PATH) == []
+
+    def test_bare_disable_is_its_own_finding(self):
+        src = (
+            "import numpy as np\n"
+            "# smklint: disable=SMK102\n"
+            "x = np.random.default_rng()\n"
+        )
+        hits = rules_hit(src, path=DATA_PATH)
+        assert "SMK100" in hits  # unjustified suppression
+        assert "SMK102" not in hits  # ... but it does suppress
+
+    def test_unknown_rule_id_is_a_finding(self):
+        src = "# smklint: disable=SMK999 -- whatever\nx = 1\n"
+        assert rules_hit(src, path=DATA_PATH) == ["SMK100"]
+
+    def test_file_wide_disable(self):
+        src = (
+            "# smklint: disable-file=SMK102 -- fixture generator module, not on the fit path\n"
+            + _VIOLATION * 2
+        )
+        assert rules_hit(src, path=DATA_PATH) == []
+
+    def test_suppression_does_not_leak_to_other_lines(self):
+        src = (
+            "import numpy as np\n"
+            "# smklint: disable=SMK102 -- deliberate\n"
+            "x = np.random.default_rng()\n"
+            "y = np.random.default_rng()\n"
+        )
+        assert rules_hit(src, path=DATA_PATH) == ["SMK102"]
+
+    def test_directives_inside_strings_are_ignored(self):
+        src = 's = "# smklint: disable=NOT_A_RULE"\n'
+        assert rules_hit(src, path=DATA_PATH) == []
+
+    def test_stale_suppression_is_reported(self):
+        """A justified disable that matches no finding is stale — it
+        would silently mask the next violation to land there."""
+        src = (
+            "# smklint: disable=SMK102 -- excused long-fixed code\n"
+            "x = 1\n"
+        )
+        assert rules_hit(src, path=DATA_PATH) == ["SMK100"]
+
+
+class TestTreeGate:
+    def test_repo_lints_clean(self):
+        """The acceptance gate as a tier-1 test: zero unsuppressed
+        findings across the whole tree (every deliberate pattern
+        carries a justified inline suppression)."""
+        findings = lint_paths(
+            [os.path.join(REPO, p)
+             for p in ("smk_tpu", "tests", "scripts", "bench.py")],
+            repo_root=REPO,
+        )
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_cli_exit_codes(self, tmp_path):
+        bad = tmp_path / "smk_tpu" / "models"
+        bad.mkdir(parents=True)
+        (bad / "m.py").write_text(
+            "import numpy as np\nx = np.random.normal()\n"
+        )
+        out = subprocess.run(
+            [
+                sys.executable, "-m", "smk_tpu.analysis.lint",
+                str(bad / "m.py"),
+            ],
+            capture_output=True, text=True,
+        )
+        assert out.returncode == 1
+        assert "SMK102" in out.stdout
+
+    def test_cli_list_rules_and_select(self, capsys):
+        # in-process (a second subprocess would re-pay the jax import
+        # against the tier-1 window for no extra coverage)
+        from smk_tpu.analysis.lint import main
+
+        assert main(["--list-rules"]) == 0
+        assert "SMK105" in capsys.readouterr().out
+        assert main(["--select", "SMK999", "x.py"]) == 2
+
+    def test_cli_rejects_bad_paths_instead_of_false_green(
+        self, capsys, tmp_path
+    ):
+        """A typo'd directory or a non-.py operand must exit 2 with a
+        message — never lint zero files and report clean."""
+        from smk_tpu.analysis.lint import main
+
+        assert main([str(tmp_path / "no_such_dir")]) == 2
+        assert "does not exist" in capsys.readouterr().err
+        notes = tmp_path / "notes.txt"
+        notes.write_text("not python")
+        assert main([str(notes)]) == 2
+        assert "neither a directory nor a .py" in (
+            capsys.readouterr().err
+        )
+
+
+@pytest.mark.parametrize("rule_id", [
+    "SMK101", "SMK102", "SMK103", "SMK104", "SMK105", "SMK106",
+    "SMK107",
+])
+def test_every_rule_documented_in_catalogue(rule_id):
+    from smk_tpu.analysis.lint import _list_rules
+
+    text = _list_rules()
+    assert rule_id in text
+    rules_md = repo_file("smk_tpu/analysis/RULES.md")
+    assert rule_id in rules_md

@@ -29,6 +29,7 @@ the new files (worst measured ~6 s), far below the sampler-scale
 tests the slow marker exists for.
 """
 
+# smklint: test-budget=pure conftest-hook unit tests, no compiles or sampling
 import conftest
 
 
