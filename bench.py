@@ -54,6 +54,13 @@ BENCH_CHUNK_PIPELINE=sync|overlap selects the chunked executor's host
 loop on every public rung (ISSUE 5; default sync — the historical
 boundary); the chunk_pipeline_ab probe cell measures the sync-vs-
 overlap A/B either way.
+BENCH_FAULT_POLICY=abort|quarantine selects the chunked executor's
+fault-isolation policy on the public rungs (ISSUE 7; default abort —
+the historical nan_guard raise). Under quarantine a non-finite subset
+is retried from its chunk-start state and dropped after
+SMKConfig.fault_max_retries; the rung record stamps fault_policy,
+retry counts and subsets_dropped (fault-free runs are bit-identical
+across policies, so the default never changes measured chains).
 
 Synthetic latent surfaces use random Fourier features (an O(n)
 stationary GP approximation) so data generation never needs an n x n
@@ -466,6 +473,12 @@ def rung_config(env, *, k, n_samples, cov_model, link, n_chains=1,
         # checkpoint host work (bit-identical draws either way; the
         # record's `pipeline` block carries the measured stall split)
         chunk_pipeline=env.get("BENCH_CHUNK_PIPELINE", "sync"),
+        # fault-isolation engine (ISSUE 7): BENCH_FAULT_POLICY
+        # =quarantine makes every public chunked rung survive a
+        # non-finite subset (retry from chunk-start state, then drop
+        # + degraded combine) instead of aborting; fault-free chains
+        # are bit-identical across policies
+        fault_policy=env.get("BENCH_FAULT_POLICY", "abort"),
         chol_block_size=int(env.get("BENCH_CHOL_BLOCK", 0)),
         # blocked-GEMM trisolves with carried panel inverses: XLA's
         # native trisolve is latency-bound at these shapes (measured
@@ -745,6 +758,7 @@ def run_rung_public(name, *, n, k, cov_model, n_samples, q=1, p=2,
         return exec_s, compile_est
 
     fit_s, compile_est = exec_split()
+    fault = pstats.fault_summary()
     record = {
         "rung": name,
         "n": n, "K": k, "m": m, "q": q, "cov_model": cov_model,
@@ -771,6 +785,15 @@ def run_rung_public(name, *, n, k, cov_model, n_samples, q=1, p=2,
             k_: v for k_, v in pstats.aggregate().items()
             if k_ != "ckpt_boundary_bytes"
         },
+        # ISSUE 7: the fault-isolation policy this rung ran under,
+        # with the compressed retry summary surfaced top-level (the
+        # same fault_summary() block also rides in pipeline.fault;
+        # the per-event boundary log stays on the live
+        # ChunkPipelineStats only) — a quarantined rung's timing is
+        # only comparable across rounds when these are zero
+        "fault_policy": cfg.fault_policy,
+        "fault_retries": fault["retries_total"],
+        "subsets_dropped": fault["subsets_dropped"],
     }
     return rung_diagnostics(
         record, res, cfg, m=m, k=k, q=q, p_dim=p, n_samples=n_samples,

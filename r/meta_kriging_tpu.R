@@ -62,6 +62,8 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
                                                         "mixture"),
                                 fused.build = c("off", "pallas"),
                                 chunk.pipeline = c("sync", "overlap"),
+                                fault.policy = c("abort", "quarantine"),
+                                fault.max.retries = 2L,
                                 n.report = NULL,
                                 checkpoint.path = NULL,
                                 backend = c("tpu", "cpu"),
@@ -114,10 +116,21 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
   # throughput lever for long checkpointed fits (see the README's
   # overlapped-pipeline section; a background write failure warns
   # and falls back to synchronous writes).
+  # fault.policy: what one numerically failed subset does to the run
+  # (ISSUE 7). "abort" (default) stops with an error naming the
+  # shards; "quarantine" retries the sick subset from its last finite
+  # chunk-start state with a fresh random stream (fault.max.retries
+  # attempts, tightened proposal step each time), then DROPS it — the
+  # combined posterior is built over the survivors, the dropped
+  # subset indices are reported, and the fit errors only when fewer
+  # than min_surviving_frac (config.overrides, default 0.5) of the
+  # n.core subsets survive. Fault-free fits are bit-identical across
+  # policies; see the README's "Fault tolerance" section.
   k.prior <- match.arg(k.prior)
   phi.proposal.family <- match.arg(phi.proposal.family)
   fused.build <- match.arg(fused.build)
   chunk.pipeline <- match.arg(chunk.pipeline)
+  fault.policy <- match.arg(fault.policy)
   # link: the reference workflow is logit (spMvGLM binomial fit,
   # 1/(1+exp(-eta)) at MetaKriging_BinaryResponse.R:160); the TPU
   # default is the exact Albert–Chib probit sampler. Users porting the
@@ -167,6 +180,8 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
     phi_proposal_family = phi.proposal.family,
     fused_build = fused.build,
     chunk_pipeline = chunk.pipeline,
+    fault_policy = fault.policy,
+    fault_max_retries = as.integer(fault.max.retries),
     priors = smk$PriorConfig(a_prior = k.prior)
   ), config.overrides)
   cfg <- do.call(smk$SMKConfig, cfg_args)
@@ -212,6 +227,9 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
     w.rhat = to_r(res$w_rhat),
     ess.per.sec = res$latent_ess_per_sec,
     phases = res$phase_seconds,
+    # 0-based subset indices dropped under fault.policy =
+    # "quarantine" (empty integer vector on a healthy run)
+    subsets.dropped = as.integer(unlist(res$subsets_dropped)),
     param.names = unlist(smk$api$param_names(as.integer(q), as.integer(p)))
   )
 }

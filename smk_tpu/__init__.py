@@ -30,6 +30,8 @@ from smk_tpu.api import (
 )
 from smk_tpu.parallel.partition import random_partition, Partition
 from smk_tpu.parallel.combine import (
+    SubsetSurvivalError,
+    apply_survival_mask,
     wasserstein_barycenter,
     weiszfeld_median,
     combine_quantile_grids,
@@ -57,6 +59,8 @@ __all__ = [
     "predict_probability",
     "random_partition",
     "Partition",
+    "SubsetSurvivalError",
+    "apply_survival_mask",
     "wasserstein_barycenter",
     "weiszfeld_median",
     "combine_quantile_grids",

@@ -1015,6 +1015,91 @@ class UnusedImportRule(Rule):
                 )
 
 
+# ---------------------------------------------------------------------------
+# SMK108 — fault-injection zone (chaos APIs are test-only)
+# ---------------------------------------------------------------------------
+
+_FAULTS_MODULE = "smk_tpu.testing"
+
+
+class FaultInjectionZoneRule(Rule):
+    id = "SMK108"
+    name = "fault-injection-zone"
+    doc = (
+        "chaos-injection APIs (smk_tpu.testing.faults) may only be "
+        "imported/armed under tests/ and scripts/ — an injector "
+        "reference in smk_tpu/ library code would ship deterministic "
+        "chaos (subset NaNs, writer failures, simulated kills) to "
+        "production fits; the harness exists to TEST the "
+        "fault-isolation engine, never to ride inside it (ISSUE 7)"
+    )
+
+    def applies(self, module):
+        norm = module.norm_path()
+        # only library code is restricted; the harness package itself,
+        # tests/, scripts/, bench.py and anything outside smk_tpu/
+        # may reference the injectors freely
+        if "smk_tpu/testing" in norm:
+            return False
+        return "smk_tpu/" in norm
+
+    def _flag(self, module, node, rendered):
+        return Finding(
+            self.id, module.path, node.lineno,
+            f"[{self.name}] {rendered} references the chaos-injection "
+            "harness from smk_tpu/ library code — fault injectors are "
+            "armed only under tests/ and scripts/ (a production fit "
+            "must never import its own saboteur); move the reference "
+            "into the test or probe script that drives it",
+        )
+
+    def check(self, module, ctx):
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.startswith(_FAULTS_MODULE):
+                        yield self._flag(
+                            module, node, f"import {a.name}"
+                        )
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                # absolute: from smk_tpu.testing[.faults] import ...
+                if mod.startswith(_FAULTS_MODULE):
+                    yield self._flag(
+                        module, node, f"from {mod} import ..."
+                    )
+                # the package-attribute spelling: from smk_tpu import
+                # testing (and the relative `from . import testing`)
+                elif (
+                    mod == "smk_tpu" or (node.level >= 1 and not mod)
+                ) and any(a.name == "testing" for a in node.names):
+                    yield self._flag(
+                        module, node,
+                        f"from {mod or '.' * node.level} import "
+                        "testing",
+                    )
+                # relative within the package: from .testing import
+                # faults / from ..testing.faults import ...
+                elif node.level >= 1 and (
+                    mod == "testing" or mod.startswith("testing.")
+                ):
+                    yield self._flag(
+                        module, node,
+                        f"from {'.' * node.level}{mod} import ...",
+                    )
+            elif isinstance(node, ast.Call):
+                # dynamic escape hatch: importlib.import_module(
+                # "smk_tpu.testing.faults") and friends
+                for arg in node.args:
+                    if isinstance(arg, ast.Constant) and isinstance(
+                        arg.value, str
+                    ) and arg.value.startswith(_FAULTS_MODULE):
+                        yield self._flag(
+                            module, node,
+                            f"dynamic import of {arg.value!r}",
+                        )
+
+
 ALL_RULES = [
     BatchingRuleRule(),
     HostNondeterminismRule(),
@@ -1023,4 +1108,5 @@ ALL_RULES = [
     PinnedProgramRule(),
     TestBudgetRule(),
     UnusedImportRule(),
+    FaultInjectionZoneRule(),
 ]

@@ -63,6 +63,11 @@ class MetaKrigingResult(NamedTuple):
         first-class output").
     phase_seconds : structured wall-clock per phase (replaces
         R:30,106,111).
+    subsets_dropped : subset indices excluded from the combine under
+        ``config.fault_policy="quarantine"`` (retry ladder exhausted,
+        grids non-finite — parallel/recovery.py). Empty on fault-free
+        runs and always empty under the default ``"abort"`` policy,
+        which raises instead of degrading.
     """
 
     param_grid: jnp.ndarray
@@ -81,6 +86,7 @@ class MetaKrigingResult(NamedTuple):
     w_rhat: jnp.ndarray
     latent_ess_per_sec: float
     phase_seconds: dict
+    subsets_dropped: tuple = ()
 
 
 def param_names(q: int, p: int) -> list[str]:
@@ -177,7 +183,8 @@ def fit_meta_kriging(
       environment); implied by ``checkpoint_path``/``progress``.
     - ``checkpoint_path``: checkpoint every chunk (every
       ``checkpoint_every`` iterations unless ``chunk_iters`` is set);
-      format v5 writes an O(1)-sized manifest plus one O(chunk) draw
+      format v6 writes an O(1)-sized manifest plus one O(chunk)
+      checksummed draw
       segment per sampling chunk, all atomic-renamed; an interrupted
       call resumes bit-exactly.
     - ``progress``: per-chunk callback(dict) with iteration count and
@@ -199,6 +206,17 @@ def fit_meta_kriging(
     ``"overlap"`` (async snapshots + background checkpoint writes;
     guard/report/checkpoint for chunk t run while the device computes
     chunk t+1). Final draws are bit-identical across modes.
+
+    ``config.fault_policy`` selects the blast radius of a non-finite
+    subset (ISSUE 7): ``"abort"`` (default) raises
+    parallel.recovery.SubsetNaNError under ``nan_guard`` exactly as
+    before; ``"quarantine"`` (implies chunked execution) retries the
+    sick subset from its last finite chunk-start state with forked
+    keys up to ``config.fault_max_retries`` times, then drops it —
+    the combine runs over the survivors, ``subsets_dropped`` is
+    stamped into the result, and the fit raises
+    parallel.combine.SubsetSurvivalError only when fewer than
+    ``config.min_surviving_frac`` of the subsets survive.
     """
     cfg = config or SMKConfig()
     times = PhaseTimes()
@@ -273,6 +291,10 @@ def fit_meta_kriging(
             or chunk_iters is not None
             or progress is not None
             or nan_guard
+            # quarantine lives in the chunked executor's boundary
+            # guard — the policy implies chunked execution just as
+            # nan_guard does
+            or cfg.fault_policy == "quarantine"
         ):
             from smk_tpu.parallel.recovery import fit_subsets_chunked
 
@@ -303,14 +325,37 @@ def fit_meta_kriging(
             )
         device_sync(results.param_grid)
 
+    # Degraded combine (ISSUE 7): under fault_policy="quarantine" a
+    # subset whose retry ladder was exhausted ships non-finite grids
+    # home; drop it from the barycenter/Weiszfeld reduction and
+    # hard-fail only below min_surviving_frac (SubsetSurvivalError).
+    # Under "abort" the executor raised long before this point, so
+    # the mask stays None and the combine is bit-identical to every
+    # prior round.
+    survival_mask = None
+    subsets_dropped: tuple = ()
+    if cfg.fault_policy == "quarantine":
+        import numpy as np
+
+        from smk_tpu.parallel.recovery import find_failed_subsets
+
+        failed = find_failed_subsets(results)
+        survival_mask = np.ones(cfg.n_subsets, bool)
+        survival_mask[failed] = False
+        subsets_dropped = tuple(int(i) for i in failed)
+
     with phase_timer(times, "combine"):
         param_grid = combine_quantile_grids(
             results.param_grid, cfg.combiner,
             n_iter=cfg.weiszfeld_iters, eps=cfg.weiszfeld_eps,
+            survival_mask=survival_mask,
+            min_surviving_frac=cfg.min_surviving_frac,
         )
         w_grid = combine_quantile_grids(
             results.w_grid, cfg.combiner,
             n_iter=cfg.weiszfeld_iters, eps=cfg.weiszfeld_eps,
+            survival_mask=survival_mask,
+            min_surviving_frac=cfg.min_surviving_frac,
         )
         device_sync((param_grid, w_grid))
 
@@ -355,4 +400,5 @@ def fit_meta_kriging(
             else 0.0
         ),
         phase_seconds=times.as_dict(),
+        subsets_dropped=subsets_dropped,
     )

@@ -20,6 +20,7 @@ LINKS = ("probit", "logit")
 COMBINERS = ("wasserstein_mean", "weiszfeld_median")
 PHI_PROPOSAL_FAMILIES = ("gaussian", "student_t", "mixture")
 CHUNK_PIPELINES = ("sync", "overlap")
+FAULT_POLICIES = ("abort", "quarantine")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -322,9 +323,51 @@ class SMKConfig:
     #   SAME compiled chunk/write programs — the pipeline only moves
     #   host work off the device's critical path. Snapshots are taken
     #   before the donated re-dispatch, so donation stays safe.
-    # Checkpoints are format v5 (incremental per-chunk segments) in
+    # Checkpoints are format v6 (incremental per-chunk checksummed
+    # segments) in
     # BOTH modes — see parallel/recovery.py.
     chunk_pipeline: str = "sync"
+
+    # Fault-isolation policy of the chunked executor
+    # (parallel/recovery.py fit_subsets_chunked) — what happens when a
+    # subset's carried state goes non-finite mid-run:
+    # - "abort" (default): today's behavior bit-identically — with
+    #   nan_guard the run raises SubsetNaNError naming the shards
+    #   before the boundary checkpoint is written; without it the NaN
+    #   silently propagates (post-hoc find_failed_subsets).
+    # - "quarantine": the share-nothing production policy. The
+    #   per-subset guard vector (the same K+4-byte _chunk_stats fetch)
+    #   is always on; a non-finite subset is rewound to its
+    #   last-finite chunk-start state and relaunched with a forked
+    #   per-subset PRNG key and a halved phi-MH step (tightened
+    #   adaptation), up to fault_max_retries attempts — the replay
+    #   re-dispatches the SAME compiled chunk program on the same
+    #   shapes (zero recompiles), and because the K fan-out is
+    #   share-nothing, the K-1 healthy subsets reproduce their chunk
+    #   bit-identically while the sick one gets fresh randomness. A
+    #   subset that exhausts its retries is dropped: its draws go
+    #   non-finite, combine_quantile_grids removes it from the
+    #   barycenter/Weiszfeld reduction via the survival mask, and the
+    #   fit hard-fails only when fewer than min_surviving_frac of the
+    #   K subsets survive. Checkpoint resume under "quarantine" is
+    #   also lenient: a corrupt/truncated draw segment (format v6
+    #   carries per-segment checksums) becomes a hole whose iteration
+    #   range is re-sampled by extending the chain, instead of a
+    #   resume-killing error. No-fault runs are bit-identical to
+    #   "abort" (the quarantine machinery only holds a state snapshot
+    #   per chunk — one extra O(state) device copy); faulted subsets'
+    #   chains are fresh attempts, not the golden chain.
+    fault_policy: str = "abort"
+    # Retry budget per subset under fault_policy="quarantine": a
+    # subset may be rewound/relaunched this many times before it is
+    # declared dead and dropped at combine. 0 = never retry (first
+    # fault drops the subset).
+    fault_max_retries: int = 2
+    # Minimum fraction of the K subsets that must survive to combine:
+    # below this, fit_meta_kriging raises
+    # parallel.combine.SubsetSurvivalError instead of silently
+    # returning a posterior built from a rump of the data.
+    min_surviving_frac: float = 0.5
 
     # Blocked-GEMM Cholesky for the phi-MH proposal factorization (the
     # one remaining O(m^3) kernel): 0 = XLA's native cholesky; > 0 =
@@ -413,6 +456,7 @@ class SMKConfig:
         "resample_size", "weiszfeld_iters", "phi_update_every",
         "cg_iters", "cg_precond_rank", "chol_block_size",
         "trisolve_block_size", "pg_n_terms", "phi_proposals",
+        "fault_max_retries",
     )
 
     def __post_init__(self):
@@ -478,6 +522,17 @@ class SMKConfig:
         if self.chunk_pipeline not in CHUNK_PIPELINES:
             raise ValueError(
                 f"chunk_pipeline must be one of {CHUNK_PIPELINES}"
+            )
+        if self.fault_policy not in FAULT_POLICIES:
+            raise ValueError(
+                f"fault_policy must be one of {FAULT_POLICIES}"
+            )
+        if self.fault_max_retries < 0:
+            raise ValueError("fault_max_retries must be >= 0")
+        if not 0.0 < self.min_surviving_frac <= 1.0:
+            raise ValueError(
+                "min_surviving_frac must be in (0, 1] — 0 would "
+                "accept a posterior built from zero subsets"
             )
         if self.chol_block_size < 0:
             raise ValueError("chol_block_size must be >= 0 (0 = XLA)")

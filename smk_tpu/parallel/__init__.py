@@ -9,6 +9,8 @@ from smk_tpu.parallel.executor import (
     make_mesh,
 )
 from smk_tpu.parallel.combine import (
+    SubsetSurvivalError,
+    apply_survival_mask,
     wasserstein_barycenter,
     weiszfeld_median,
     combine_quantile_grids,
@@ -31,6 +33,8 @@ __all__ = [
     "find_failed_subsets",
     "rerun_subsets",
     "SubsetNaNError",
+    "SubsetSurvivalError",
+    "apply_survival_mask",
     "make_mesh",
     "wasserstein_barycenter",
     "weiszfeld_median",

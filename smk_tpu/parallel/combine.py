@@ -11,16 +11,49 @@ W2 distance between subset posteriors is the L2 distance between
 their quantile functions, so the geometric median of the K quantile
 curves (per scalar quantity) is the W2 geometric-median posterior
 (the "median posterior" of Minsker et al., robust to subset
-outliers). It runs as a fixed-iteration Weiszfeld fixed point —
-static control flow, vmapped over quantities, reduction over the
-(possibly mesh-sharded) K axis, so on TPU it lowers to ICI
+outliers). It runs as a fixed-iteration Vardi–Zhang-guarded Weiszfeld
+fixed point — static control flow, vmapped over quantities, reduction
+over the (possibly mesh-sharded) K axis, so on TPU it lowers to ICI
 all-reduces.
+
+Graceful degradation (ISSUE 7): under the chunked executor's
+``fault_policy="quarantine"``, subsets whose retries were exhausted
+ship non-finite grids home instead of killing the run; both combiners
+accept a ``survival_mask`` that drops those subsets from the K-axis
+reduction, hard-failing with :class:`SubsetSurvivalError` only when
+fewer than ``min_surviving_frac`` of the subsets survive — the
+Minsker-style median is robust to subset *outliers*, but a NaN curve
+is not an outlier, it is poison, and must be removed before the
+reduction.
 """
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+
+class SubsetSurvivalError(RuntimeError):
+    """Too few subsets survived the fit to combine: the degraded
+    posterior would summarize less than ``min_surviving_frac`` of the
+    partitioned data. Carries the counts for the caller's report."""
+
+    def __init__(self, n_surviving: int, n_total: int, min_frac: float):
+        self.n_surviving = int(n_surviving)
+        self.n_total = int(n_total)
+        self.min_frac = float(min_frac)
+        super().__init__(
+            f"only {self.n_surviving}/{self.n_total} subsets survived "
+            f"the fit but min_surviving_frac={min_frac} requires at "
+            f"least {max(1, int(np.ceil(min_frac * n_total)))} — the "
+            "combined posterior would silently summarize a rump of "
+            "the data; inspect the dropped subsets (NaN grids, "
+            "find_failed_subsets) or lower config.min_surviving_frac "
+            "deliberately"
+        )
 
 
 def wasserstein_barycenter(grids: jnp.ndarray) -> jnp.ndarray:
@@ -40,14 +73,52 @@ def weiszfeld_median(
         y <- sum_k x_k / ||x_k - y||  /  sum_k 1 / ||x_k - y||
     from the barycenter. Monotonicity of the result is preserved
     (it is a convex combination of monotone quantile functions).
+
+    Exact-coincidence guard (Vardi & Zhang 2000): when the iterate
+    lands ON one of the K curves — which happens whenever one subset's
+    curve IS the median, and transiently when curves are duplicated —
+    the raw Weiszfeld weight ``1/dist`` spikes to ``1/sqrt(eps)`` and
+    the iteration can stall at a non-optimal vertex. Coincident curves
+    (distance below a relative tolerance) are therefore given zero
+    Weiszfeld weight, the remaining points' update T(y) is blended
+    with the current iterate by the Vardi–Zhang step
+    ``gamma = min(1, eta / ||R(y)||)`` (``eta`` = number of coincident
+    curves, ``R`` the weighted residual), which keeps y fixed exactly
+    when the coincident data point is optimal and escapes it
+    otherwise. With no coincidence the step reduces to classic
+    Weiszfeld. Fixed-point tolerance note: ``n_iter`` is static (no
+    data-dependent convergence test — TPU-friendly control flow);
+    at the default 50 iterations the fixed point is resolved far below
+    fp32 resolution for well-separated curves, and the coincidence
+    tolerance is ``sqrt(eps)`` RELATIVE to the curves' magnitude, so
+    ``eps`` bounds both the smallest distinguishable curve distance
+    and the weight spike the old form allowed.
     """
 
     def median_one(curves: jnp.ndarray) -> jnp.ndarray:
         # curves: (K, n_q) quantile functions of one scalar quantity
+        scale = jnp.maximum(jnp.max(jnp.abs(curves)), 1.0)
+        tol = jnp.sqrt(jnp.asarray(eps, curves.dtype)) * scale
+        tiny = jnp.asarray(eps, curves.dtype) * scale
+
         def body(_, y):
-            dist = jnp.sqrt(jnp.sum((curves - y[None]) ** 2, axis=1) + eps)
-            w = 1.0 / dist
-            return (w[:, None] * curves).sum(0) / w.sum()
+            diff = curves - y[None]
+            dist = jnp.sqrt(jnp.sum(diff**2, axis=1))
+            near = dist < tol
+            w = jnp.where(near, 0.0, 1.0 / jnp.maximum(dist, tol))
+            wsum = jnp.sum(w)
+            t_y = (w[:, None] * curves).sum(0) / jnp.maximum(wsum, tiny)
+            # Vardi–Zhang: R(y) = sum_k w_k (x_k - y); with eta
+            # coincident points, step toward T(y) by 1 - eta/||R||
+            # (clamped) — exactly stationary when the vertex is the
+            # true median, a guaranteed-descent escape otherwise.
+            r = (w[:, None] * diff).sum(0)
+            rnorm = jnp.sqrt(jnp.sum(r**2))
+            eta = jnp.sum(near.astype(curves.dtype))
+            gamma = jnp.minimum(1.0, eta / jnp.maximum(rnorm, tiny))
+            y_next = (1.0 - gamma) * t_y + gamma * y
+            # all curves coincident with y (identical subsets): done
+            return jnp.where(wsum > 0, y_next, y)
 
         return jax.lax.fori_loop(0, n_iter, body, jnp.mean(curves, axis=0))
 
@@ -56,14 +127,57 @@ def weiszfeld_median(
     return jnp.moveaxis(out, 0, -1)
 
 
+def apply_survival_mask(
+    grids: jnp.ndarray,
+    survival_mask,
+    *,
+    min_surviving_frac: float = 0.0,
+) -> jnp.ndarray:
+    """Drop dead subsets from a (K, n_q, d) grid stack.
+
+    ``survival_mask`` is a (K,) boolean vector (True = subset
+    survived); permanently-quarantined subsets (retry ladder
+    exhausted, parallel/recovery.py) are removed from the leading axis
+    before any combiner reduction. Raises :class:`SubsetSurvivalError`
+    when fewer than ``max(1, ceil(min_surviving_frac * K))`` survive.
+    An all-True mask returns ``grids`` unchanged (bit-identity for
+    fault-free runs)."""
+    mask = np.asarray(survival_mask, bool).reshape(-1)
+    k = int(grids.shape[0])
+    if mask.shape[0] != k:
+        raise ValueError(
+            f"survival_mask has {mask.shape[0]} entries for {k} "
+            "subset grids"
+        )
+    n_surv = int(mask.sum())
+    if n_surv < max(1, int(np.ceil(min_surviving_frac * k))):
+        raise SubsetSurvivalError(n_surv, k, min_surviving_frac)
+    if mask.all():
+        return grids
+    return jnp.asarray(grids)[np.where(mask)[0]]
+
+
 def combine_quantile_grids(
     grids: jnp.ndarray,
     method: str = "wasserstein_mean",
     *,
     n_iter: int = 50,
     eps: float = 1e-8,
+    survival_mask: Optional[np.ndarray] = None,
+    min_surviving_frac: float = 0.0,
 ) -> jnp.ndarray:
-    """Dispatch on the configured combiner."""
+    """Dispatch on the configured combiner.
+
+    ``survival_mask`` (optional, (K,) bool): degraded combine — dead
+    subsets are dropped from the reduction (see
+    :func:`apply_survival_mask`); fails with
+    :class:`SubsetSurvivalError` below ``min_surviving_frac``.
+    """
+    if survival_mask is not None:
+        grids = apply_survival_mask(
+            grids, survival_mask,
+            min_surviving_frac=min_surviving_frac,
+        )
     if method == "wasserstein_mean":
         return wasserstein_barycenter(grids)
     if method == "weiszfeld_median":

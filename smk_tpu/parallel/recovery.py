@@ -51,6 +51,7 @@ from smk_tpu.parallel.executor import (
 from smk_tpu.parallel.partition import Partition
 from smk_tpu.utils.checkpoint import (
     BackgroundWriter,
+    is_key_leaf,
     load_pytree,
     load_segment,
     save_pytree,
@@ -68,10 +69,17 @@ from smk_tpu.utils.tracing import ChunkPipelineStats
 # only, O(1) in the iteration count) and each chunk boundary appends
 # one `<path>.segNNNNN.npz` file holding only that chunk's new kept
 # draws, so per-boundary checkpoint I/O is O(chunk) instead of
-# re-serializing the whole filled draws region (O(it)). A bump
-# invalidates older files with a clear error instead of a generic
-# structure mismatch.
-CKPT_VERSION = 5
+# re-serializing the whole filled draws region (O(it)); v6 the
+# fault-isolation fields (ISSUE 7) — every draw segment carries a
+# payload checksum (utils/checkpoint.segment_checksum) and the
+# manifest carries the per-subset quarantine bookkeeping
+# (fault_attempts / fault_dead), so resume under
+# fault_policy="quarantine" can skip a corrupt/truncated segment and
+# re-sample its iteration range instead of crashing, and a resumed
+# run remembers which subsets are already dead. A bump invalidates
+# older files with a clear error instead of a generic structure
+# mismatch.
+CKPT_VERSION = 6
 
 
 class ProgressAbort(Exception):
@@ -80,6 +88,18 @@ class ProgressAbort(Exception):
     gate subclasses this). Any other exception from a user callback is
     caught, warned about once, and the run keeps sampling — a broken
     logging hook must not kill a multi-hour fan-out mid-flight."""
+
+
+class _QuarantineRewind(Exception):
+    """Internal control flow of the quarantine engine: a boundary's
+    guard found non-finite subsets with retry budget left. Carries the
+    (K,) retry mask; the executor loop catches it, rewinds to the
+    boundary's held chunk-start state with forked keys, and re-runs
+    the plan from that chunk. Never escapes fit_subsets_chunked."""
+
+    def __init__(self, retry_mask):
+        self.retry_mask = retry_mask
+        super().__init__("quarantine rewind")
 
 
 class SubsetNaNError(RuntimeError):
@@ -115,6 +135,23 @@ def _finite_subsets(state) -> jnp.ndarray:
     return jnp.stack(oks).all(axis=0)
 
 
+@jax.jit
+def _subset_draws_finite(param_draws, w_draws):
+    """(K,) bool: every RECORDED draw of each subset finite — the
+    terminal-boundary quarantine verdict (a final-sweep state fault
+    that never reached the kept draws must not drop a subset whose
+    data is fine; mid-run faults don't need this because a NaN carry
+    poisons every later chunk's draws). The preallocated zero tail is
+    finite, so the reduce runs over the full accumulators."""
+    ok_p = jnp.isfinite(param_draws).reshape(
+        param_draws.shape[0], -1
+    ).all(axis=1)
+    ok_w = jnp.isfinite(w_draws).reshape(
+        w_draws.shape[0], -1
+    ).all(axis=1)
+    return ok_p & ok_w
+
+
 # smklint: pinned-program (fusing this fetch into the chunk program breaks
 # the cross-mode bit-identity contract — see docstring)
 @jax.jit
@@ -135,13 +172,80 @@ def _chunk_stats(state):
     return _finite_subsets(state), jnp.mean(state.phi_accept)
 
 
+def _clone_leaf(leaf):
+    """Fresh device buffer with ``leaf``'s value; typed PRNG keys are
+    cloned through their raw key data (jnp.copy rejects key dtypes on
+    this jax) and re-wrapped, so the clone stays a drop-in carry."""
+    if is_key_leaf(leaf):
+        return jax.random.wrap_key_data(
+            jnp.copy(jax.random.key_data(leaf))
+        )
+    return jnp.copy(leaf)
+
+
+@jax.jit
+def _held_clone(state):
+    """On-device clone of the whole carried state — the quarantine
+    engine's per-chunk snapshot. Taken BEFORE the chunk dispatch
+    donates the carry, so a faulted chunk can be rewound to its exact
+    start state (the same clone-before-donate order HostSnapshot
+    uses); one O(state) device copy per chunk is quarantine mode's
+    whole steady-state overhead, and the chunk programs themselves
+    are untouched (no-fault runs stay bit-identical to "abort")."""
+    return jax.tree_util.tree_map(_clone_leaf, state)
+
+
+def _make_refork(n_chains: int):
+    """Build the quarantine relaunch program: subsets in ``mask`` get
+    their chunk-start state back with (a) a PRNG key forked by their
+    attempt count (jax.random.fold_in — deterministic, so a chaos
+    protocol replays exactly) and (b) a halved phi-MH step (tightened
+    adaptation compounds across attempts: each retry starts from the
+    previously tightened held state). Everything else is held — the
+    K-1 unmasked subsets pass through bit-identically, which is what
+    makes the replayed chunk reproduce their draws exactly."""
+
+    def fork_one(key, attempt):
+        return jax.random.fold_in(key, attempt)
+
+    if n_chains > 1:
+        fork = jax.vmap(
+            jax.vmap(fork_one, in_axes=(0, None)), in_axes=(0, 0)
+        )
+    else:
+        fork = jax.vmap(fork_one, in_axes=(0, 0))
+
+    def sel(mask, new, old):
+        m = mask.reshape((-1,) + (1,) * (old.ndim - 1))
+        return jnp.where(m, new, old)
+
+    def refork(state, mask, attempts):
+        keys = state.key
+        forked = fork(keys, attempts)
+        # is_key_leaf is a trace-static dtype probe (concrete dtype
+        # at trace time, never a tracer)
+        if is_key_leaf(keys):
+            kd = jax.random.key_data(keys)
+            new_key = jax.random.wrap_key_data(
+                sel(mask, jax.random.key_data(forked), kd)
+            )
+        else:
+            new_key = sel(mask, forked, keys)
+        ls = state.phi_log_step
+        tightened = sel(
+            mask, ls + jnp.log(jnp.asarray(0.5, ls.dtype)), ls
+        )
+        return state._replace(key=new_key, phi_log_step=tightened)
+
+    return jax.jit(refork)
+
+
 def _key_bytes(key) -> bytes:
     """Raw bytes of a PRNG key, accepting both typed keys and legacy
     raw uint32 key arrays (jax.random.split handles both; the
     fingerprint must too, or the checkpointed executor would
     hard-require typed keys that the rest of the fit path doesn't)."""
-    dt = getattr(key, "dtype", None)
-    if dt is not None and jax.dtypes.issubdtype(dt, jax.dtypes.prng_key):
+    if is_key_leaf(key):
         return np.asarray(jax.random.key_data(key)).tobytes()
     return np.ascontiguousarray(key).tobytes()
 
@@ -219,10 +323,23 @@ def _run_identity(cfg, key, data, beta_init) -> np.ndarray:
     compiled chunk programs and produce bit-identical chains, so a
     run checkpointed under "overlap" must be resumable under "sync"
     (the operational escape hatch when a background writer
-    misbehaves) and vice versa."""
+    misbehaves) and vice versa. The fault-isolation knobs
+    (fault_policy / fault_max_retries / min_surviving_frac) are
+    normalized out for the same reason: a fault-free chain is
+    bit-identical across policies, and resuming a "quarantine"
+    checkpoint under "abort" (or with a different retry budget) is
+    the operational escape hatch when the quarantine engine itself
+    misbehaves — the manifest's fault bookkeeping rides along either
+    way."""
     import dataclasses
 
-    cfg_ident = dataclasses.replace(cfg, chunk_pipeline="sync")
+    cfg_ident = dataclasses.replace(
+        cfg,
+        chunk_pipeline="sync",
+        fault_policy="abort",
+        fault_max_retries=2,
+        min_surviving_frac=0.5,
+    )
     crcs = [zlib.crc32(repr(cfg_ident).encode())]
     crcs.append(zlib.crc32(_key_bytes(key)))
     for leaf in jax.tree_util.tree_leaves(data):
@@ -324,7 +441,7 @@ def _cached_program(model, key, build):
 
 
 def _read_segments(path, seg_base, n_segments, filled, dtype):
-    """Assemble the filled kept-draw region from the v5 segment files
+    """Assemble the filled kept-draw region from the segment files
     seg_base..seg_base+n_segments-1, validating contiguous coverage
     [0, filled). Returns (param, w) numpy arrays of filled length (or
     (None, None) when nothing is filled yet)."""
@@ -335,12 +452,16 @@ def _read_segments(path, seg_base, n_segments, filled, dtype):
                 "segments recorded but no filled draws"
             )
         return None, None
+    import zipfile
+
     parts_p, parts_w = [], []
     cursor = 0
     for i in range(seg_base, seg_base + n_segments):
         try:
             seg = load_segment(path, i)
-        except (OSError, KeyError, ValueError) as e:
+        except (
+            OSError, KeyError, ValueError, zipfile.BadZipFile,
+        ) as e:
             raise ValueError(
                 f"checkpoint {path} is missing or has a corrupt draw "
                 f"segment {segment_path(path, i)} — the manifest "
@@ -374,8 +495,82 @@ def _read_segments(path, seg_base, n_segments, filled, dtype):
     )
 
 
+def _read_segments_lenient(
+    path, seg_base, n_segments, filled, dtype, lead, d_par, d_w
+):
+    """Fault-tolerant v6 segment assembly (fault_policy="quarantine"):
+    every readable, checksum-clean, shape-consistent segment lands at
+    its recorded range; everything else — truncated files, bit flips
+    (utils/checkpoint.segment_checksum), missing files, overlapping
+    or out-of-bounds ranges — becomes a HOLE the executor re-samples
+    by extending the chain, instead of a resume-killing error.
+
+    Returns ``(param, w, holes)`` where param/w are full
+    ``lead + (filled, d)`` arrays (zeros inside holes) and ``holes``
+    is a sorted list of disjoint kept-iteration ranges ``(a, b)`` not
+    covered by any good segment. With zero filled draws returns
+    ``(None, None, [])``.
+    """
+    import zipfile
+
+    if filled <= 0:
+        return None, None, []
+    param = np.zeros(lead + (filled, d_par), dtype)
+    w = np.zeros(lead + (filled, d_w), dtype)
+    covered = np.zeros(filled, bool)
+    for i in range(seg_base, seg_base + n_segments):
+        try:
+            seg = load_segment(path, i)
+        except (
+            OSError, KeyError, ValueError, zipfile.BadZipFile,
+        ) as e:
+            warnings.warn(
+                f"checkpoint {path}: draw segment "
+                f"{segment_path(path, i)} is corrupt or unreadable "
+                f"({e!r}); its iteration range will be re-sampled "
+                "(fault_policy='quarantine' lenient resume)",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+            continue
+        a, b = seg["start"], seg["stop"]
+        if (
+            not 0 <= a < b <= filled
+            or seg["param"].shape[-2] != b - a
+            or seg["w"].shape[-2] != b - a
+            or seg["param"].shape[:-2] != lead
+            or seg["param"].shape[-1] != d_par
+            or seg["w"].shape[-1] != d_w
+            or covered[a:b].any()
+        ):
+            warnings.warn(
+                f"checkpoint {path}: draw segment "
+                f"{segment_path(path, i)} records range [{a}, {b}) "
+                "inconsistent with the manifest (shape/bounds/"
+                "overlap); treating it as corrupt — its range will "
+                "be re-sampled",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+            continue
+        param[..., a:b, :] = np.asarray(seg["param"], dtype)
+        w[..., a:b, :] = np.asarray(seg["w"], dtype)
+        covered[a:b] = True
+    holes = []
+    pos = 0
+    while pos < filled:
+        if covered[pos]:
+            pos += 1
+            continue
+        start = pos
+        while pos < filled and not covered[pos]:
+            pos += 1
+        holes.append((start, pos))
+    return param, w, holes
+
+
 class _SegmentedCheckpoint:
-    """v5 checkpoint state machine: manifest + ordered draw segments.
+    """v6 checkpoint state machine: manifest + ordered draw segments.
 
     On-disk layout (see CKPT_VERSION): ``path`` is the manifest (an
     atomic npz holding the carried state, counters, identity and the
@@ -412,6 +607,7 @@ class _SegmentedCheckpoint:
         writer: Optional[BackgroundWriter] = None,
         pstats: Optional[ChunkPipelineStats] = None,
         full_draws=None,  # callable filled -> (param_np, w_np)
+        fault_src=None,  # callable -> (attempts_np, dead_np) copies
     ):
         self.path = path
         self.meta = meta
@@ -420,6 +616,10 @@ class _SegmentedCheckpoint:
         self.writer = writer
         self.pstats = pstats
         self._full_draws = full_draws
+        k = int(meta[2])
+        self._fault_src = fault_src or (
+            lambda: (np.zeros(k, np.int64), np.zeros(k, np.int64))
+        )
         # counters below are touched only by whichever thread executes
         # the writes (strictly ordered: the writer thread in overlap
         # mode, the caller in sync/degraded mode — degradation flushes
@@ -432,7 +632,10 @@ class _SegmentedCheckpoint:
 
     # ---- raw write paths (run on the writing thread) -------------
 
-    def _write_manifest(self, state_np, it: int) -> int:
+    def _write_manifest(self, state_np, it: int, fault=None) -> int:
+        if fault is None:
+            fault = self._fault_src()
+        attempts, dead = fault
         return save_pytree(
             self.path,
             {
@@ -444,10 +647,16 @@ class _SegmentedCheckpoint:
                 "seg_base": np.asarray([self.seg_base], np.int64),
                 "n_segments": np.asarray([self.n_segments], np.int64),
                 "filled": np.asarray([self.filled], np.int64),
+                # v6 quarantine bookkeeping: per-subset retry attempt
+                # counts and the permanently-dead mask, so a resumed
+                # run neither re-grants a dead subset its retry
+                # budget nor re-flags it every boundary
+                "fault_attempts": np.asarray(attempts, np.int64),
+                "fault_dead": np.asarray(dead, np.int64),
             },
         )
 
-    def _write(self, state_np, seg, it: int) -> None:
+    def _write(self, state_np, seg, it: int, fault=None) -> None:
         """One boundary's I/O: optional new segment, then manifest.
         ``seg`` is None (burn boundary) or (param, w, start, stop)."""
         t0 = time.perf_counter()
@@ -461,7 +670,7 @@ class _SegmentedCheckpoint:
                 )
                 self.n_segments += 1
                 self.filled = stop
-        nbytes += self._write_manifest(state_np, it)
+        nbytes += self._write_manifest(state_np, it, fault)
         if self.pstats is not None:
             self.pstats.add_ckpt_write(
                 time.perf_counter() - t0, nbytes
@@ -509,7 +718,7 @@ class _SegmentedCheckpoint:
             and not self.degraded
             and self.writer.error is not None
         ):
-            err = self.writer.error
+            err = self.writer.acknowledge_error()
             warnings.warn(
                 f"background checkpoint writer failed ({err!r}); "
                 "degrading to synchronous checkpoint writes — the "
@@ -547,9 +756,14 @@ class _SegmentedCheckpoint:
             param, w = materialize(draws)
             seg = (param, w, start, stop)
 
+        # snapshot the quarantine bookkeeping on the CALLER thread —
+        # the executor mutates the live attempts/dead arrays, so a
+        # background job must serialize the values as of THIS boundary
+        fault = self._fault_src()
+
         if self.writer is not None and not self.degraded:
             self.writer.submit(
-                lambda: self._write(state_np, seg, it)
+                lambda: self._write(state_np, seg, it, fault)
             )
             return
         # inline (sync mode, or degraded overlap)
@@ -558,7 +772,7 @@ class _SegmentedCheckpoint:
             self._write_full(state_np, param, w, it, filled)
             self._need_full = False
             return
-        self._write(state_np, seg, it)
+        self._write(state_np, seg, it, fault)
 
     def ensure_synced(self, state_live, it: int, filled: int) -> None:
         """Drain the background writer; if any write was lost, rewrite
@@ -592,6 +806,21 @@ class _SegmentedCheckpoint:
         any orphans a kill strands) can never be misread."""
         self._write_full(state_np, param, w, it, filled)
 
+    def rewrite_full(self, state_np, param, w, it: int, filled: int):
+        """Inline full rewrite from caller-supplied draws — the
+        hole-refill completion write (lenient resume re-sampled one
+        or more corrupt segments' ranges; the per-boundary appends
+        deliberately skipped those out-of-order writes, so ONE merged
+        segment + manifest now publishes the complete, verified draw
+        region). Drains the background writer first so no stale
+        append can land after the rewrite."""
+        if self.writer is not None:
+            self.writer.flush()
+            if self.writer.error is not None:
+                self._check_degrade()
+        self._write_full(state_np, param, w, it, filled)
+        self._need_full = False
+
 
 def fit_subsets_chunked(
     model: SpatialGPSampler,
@@ -621,7 +850,7 @@ def fit_subsets_chunked(
     - ``chunk_size``: lax.map over K-chunks inside each dispatch to
       bound resident memory (same lever as fit_subsets_vmap);
     - ``checkpoint_path``: checkpoint after every chunk (including
-      burn-in chunks); format v5 writes a manifest (carried state +
+      burn-in chunks); format v6 writes a manifest (carried state +
       counters, O(1) in the iteration count) plus ONE incremental
       draw segment per sampling chunk (O(chunk) bytes — see
       :class:`_SegmentedCheckpoint`), every file atomic-renamed; an
@@ -629,11 +858,13 @@ def fit_subsets_chunked(
       in the carried state);
     - ``progress``: callback(dict) after every chunk — the n.report
       parity hook (the reference prints acceptance every 10 batches,
-      MetaKriging_BinaryResponse.R:84); receives phase, iteration,
-      n_samples and the running phi acceptance rate. A callback that
-      raises is caught and warned about ONCE, and the run keeps
-      sampling; raise a :class:`ProgressAbort` subclass to abort
-      deliberately.
+      MetaKriging_BinaryResponse.R:84); receives phase ("burn" or
+      "sample"), iteration (<= n_samples), n_samples and the running
+      phi acceptance rate. Lenient-resume refill chunks (holes
+      re-sampled past n_samples) are NOT reported — they would break
+      the phase/iteration contract. A callback that raises is caught
+      and warned about ONCE, and the run keeps sampling; raise a
+      :class:`ProgressAbort` subclass to abort deliberately.
 
     - ``nan_guard``: after every chunk, check the carried state's
       small leaves for NaN/inf per subset and raise
@@ -643,6 +874,28 @@ def fit_subsets_chunked(
       + host fetch per chunk (``_chunk_stats`` — the guard/report
       fetches never touch the full carried state); the post-hoc net
       is find_failed_subsets.
+
+    ``model.config.fault_policy`` selects what a non-finite subset
+    does to the run (ISSUE 7). ``"abort"`` (default) is the historical
+    contract above, bit-identically. ``"quarantine"`` turns the guard
+    into a fault-isolation engine: the per-subset finite vector is
+    fetched every boundary regardless of ``nan_guard``; a faulted
+    subset is rewound to its held chunk-start state and relaunched
+    with a forked PRNG key + halved phi step (the replayed chunk is
+    the SAME compiled program, and the share-nothing K fan-out means
+    the healthy K-1 subsets reproduce their draws bit-identically);
+    after ``fault_max_retries`` failed relaunches the subset is
+    declared dead and the run continues without it (its draws stay
+    non-finite; ``combine_quantile_grids``'s survival mask drops it,
+    api.fit_meta_kriging enforces ``min_surviving_frac``). Resume is
+    lenient under quarantine: a corrupt/truncated v6 draw segment
+    (per-segment checksums) becomes a hole re-sampled by extending
+    the chain. Retry accounting and drop decisions are surfaced via
+    ``pipeline_stats`` (ChunkPipelineStats.fault_events) and
+    persisted in the checkpoint manifest. No-fault quarantine runs
+    are bit-identical to ``"abort"`` — the engine adds one O(state)
+    device clone per chunk and touches nothing inside the chunk
+    programs.
 
     ``stop_after_chunks`` ends the run early after that many chunks
     (burn or sampling), returning None with the checkpoint on disk —
@@ -763,12 +1016,21 @@ def fit_subsets_chunked(
         "seg_base": np.asarray([0], np.int64),
         "n_segments": np.asarray([0], np.int64),
         "filled": np.asarray([0], np.int64),
+        "fault_attempts": np.zeros(k, np.int64),
+        "fault_dead": np.zeros(k, np.int64),
     }
 
     mode = cfg.chunk_pipeline
+    policy_q = cfg.fault_policy == "quarantine"
+    # quarantine bookkeeping, host-side (mutated in place; the
+    # checkpoint snapshots copies per boundary): per-subset relaunch
+    # attempt counts and the permanently-dead mask
+    attempts = np.zeros(k, np.int64)
+    dead = np.zeros(k, bool)
     pstats = pipeline_stats
     if pstats is not None:
         pstats.mode = mode
+        pstats.fault_policy = cfg.fault_policy
 
     writer = (
         BackgroundWriter()
@@ -786,6 +1048,9 @@ def fit_subsets_chunked(
             full_draws=lambda filled: _fetch_draws_slice(
                 param_draws, w_draws, filled
             ),
+            fault_src=lambda: (
+                attempts.copy(), dead.astype(np.int64),
+            ),
         )
 
     if checkpoint_path is not None and os.path.exists(checkpoint_path):
@@ -801,10 +1066,11 @@ def fit_subsets_chunked(
                 "the n_chains meta + sampled identity, v5 the "
                 "incremental draw-segment layout: the file is now a "
                 "manifest and kept draws live in sidecar "
-                "<path>.segNNNNN.npz files) — "
-                "it was written by an older build or for a different "
-                "run shape; delete the file or pass a fresh "
-                "checkpoint_path"
+                "<path>.segNNNNN.npz files, v6 the per-segment "
+                "integrity checksums + fault-quarantine bookkeeping) "
+                "— it was written by an older build or for a "
+                "different run shape; delete the file or pass a "
+                "fresh checkpoint_path"
             ) from e
         if int(np.asarray(ckpt["version"])[0]) != CKPT_VERSION:
             raise ValueError(
@@ -838,20 +1104,39 @@ def fit_subsets_chunked(
                 f"iteration counter {it} implies "
                 f"{max(0, it - cfg.n_burn_in)}"
             )
-        param_np, w_np = _read_segments(
-            checkpoint_path, seg_base, n_seg, filled, dtype
-        )
+        attempts[:] = np.asarray(ckpt["fault_attempts"], np.int64)
+        dead[:] = np.asarray(ckpt["fault_dead"], np.int64) != 0
+        lead = (k,) if cfg.n_chains == 1 else (k, cfg.n_chains)
+        if policy_q:
+            # lenient: a corrupt/truncated/checksum-failed segment
+            # becomes a hole whose kept-iteration range is re-sampled
+            # by extending the chain (fill chunks appended to the
+            # plan below) instead of killing the resume
+            param_np, w_np, holes = _read_segments_lenient(
+                checkpoint_path, seg_base, n_seg, filled, dtype,
+                lead, d_par, d_w,
+            )
+        else:
+            param_np, w_np = _read_segments(
+                checkpoint_path, seg_base, n_seg, filled, dtype
+            )
+            holes = []
         if filled > 0:
             param_draws = to_capacity(jnp.asarray(param_np, dtype))
             w_draws = to_capacity(jnp.asarray(w_np, dtype))
         else:
             param_draws, w_draws = empty_draws()
         ck.adopt(seg_base, n_seg, filled)
-        if n_seg > 1:
+        if n_seg > 1 and not holes:
             # resume-time compaction: merge the per-chunk segments
             # into one so the file count stays bounded across
             # kill/resume cycles (one ordered O(filled) rewrite to a
-            # fresh index — crash-safe, see _write_full)
+            # fresh index — crash-safe, see _write_full). Skipped
+            # when holes exist: compacting would bake the zeroed
+            # hole ranges into a checksum-clean segment and lose the
+            # corruption evidence a killed refill run needs to
+            # re-detect — the post-refill rewrite_full compacts
+            # instead.
             ck.compact(state, param_np, w_np, it, filled)
         if put is not None:
             state = put(state)
@@ -861,6 +1146,7 @@ def fit_subsets_chunked(
         state = _init_states(model, keys, data, beta_init)
         param_draws, w_draws = empty_draws()
         it = 0
+        holes = []
 
     def chunk_fn(kind: str, n: int):
         return _cached_program(
@@ -869,7 +1155,9 @@ def fit_subsets_chunked(
         )
 
     n_burn = cfg.n_burn_in
-    want_stats = nan_guard or progress is not None
+    # quarantine needs the per-subset guard vector at every boundary
+    # whether or not the caller asked for nan_guard/progress
+    want_stats = nan_guard or progress is not None or policy_q
     warned_progress = [False]
 
     def call_progress(info):
@@ -914,17 +1202,38 @@ def fit_subsets_chunked(
     # The chunk schedule is fully determined by (it, chunk_iters):
     # both pipeline modes execute exactly this plan, so the compiled
     # programs and their dispatch order — the only things the chain's
-    # bits depend on — are identical across modes.
+    # bits depend on — are identical across modes. Entries are
+    # (kind, start_iteration, n_iters, write_offset): write_offset is
+    # where a collecting chunk's draws land on the kept-iteration
+    # axis (start - n_burn for ordinary sampling chunks; a hole's own
+    # offset for lenient-resume refill chunks).
     plan = []
     it_plan = it
     while it_plan < n_burn:
         n = min(chunk_iters, n_burn - it_plan)
-        plan.append(("burn", it_plan, n))
+        plan.append(("burn", it_plan, n, 0))
         it_plan += n
     while it_plan < cfg.n_samples:
         n = min(chunk_iters, cfg.n_samples - it_plan)
-        plan.append(("samp", it_plan, n))
+        plan.append(("samp", it_plan, n, it_plan - n_burn))
         it_plan += n
+    # Hole refill (lenient v6 resume under fault_policy="quarantine"):
+    # each corrupt segment's kept range is re-sampled by EXTENDING the
+    # chain — global iterations continue past n_samples (the carried
+    # PRNG key makes them fresh draws of the same chain) and the
+    # outputs are written at the hole's offset. The refilled rows are
+    # out of time-order relative to their neighbors, which is
+    # irrelevant to the quantile compression (order-invariant) and a
+    # documented approximation for the autocorrelation diagnostics —
+    # the alternative was a dead checkpoint.
+    for a, b_ in holes:
+        ofs, left = a, b_ - a
+        while left > 0:
+            n_f = min(chunk_iters, left)
+            plan.append(("fill", it_plan, n_f, ofs))
+            it_plan += n_f
+            ofs += n_f
+            left -= n_f
     truncated = False
     if stop_after_chunks is not None and stop_after_chunks < len(plan):
         plan = plan[:stop_after_chunks]
@@ -932,8 +1241,16 @@ def fit_subsets_chunked(
 
     stats_bytes = k + 4  # (K,) bool + one f32 scalar per boundary
     t_loop0 = time.perf_counter()
+    refork = (
+        _cached_program(
+            model, ("refork", k),
+            lambda: _make_refork(cfg.n_chains),
+        )
+        if policy_q
+        else None
+    )
 
-    def dispatch(kind, start, n):
+    def dispatch(kind, start, n, w_ofs):
         """Issue one chunk's device work; returns the new carry."""
         nonlocal state, param_draws, w_draws, it
         # device_put (not jnp.asarray) keeps this scalar feed an
@@ -943,20 +1260,102 @@ def fit_subsets_chunked(
         if kind == "burn":
             state = chunk_fn("burn", n)(data, state, start_dev)
         else:
+            # "fill" chunks run the SAME compiled sampling program —
+            # only their write offset differs (a traced scalar, so no
+            # recompile per hole)
             state, (pd, wd) = chunk_fn("samp", n)(
                 data, state, start_dev
             )
-            # draws land at [start - n_burn, start - n_burn + n) on
-            # the iteration axis of the PREALLOCATED accumulators —
-            # axis 1 for a single chain (K, kept, d), axis 2 with
-            # chains (K, C, kept, d) — with the old buffer DONATED
-            # into the same-shaped update output on donation-capable
-            # backends (executor.write_draws; shape-matching is what
-            # makes the donation actually alias, unlike a growing
-            # concat).
-            param_draws = write_draws(param_draws, pd, start - n_burn)
-            w_draws = write_draws(w_draws, wd, start - n_burn)
-        it = start + n
+            # draws land at [w_ofs, w_ofs + n) on the iteration axis
+            # of the PREALLOCATED accumulators — axis 1 for a single
+            # chain (K, kept, d), axis 2 with chains (K, C, kept, d)
+            # — with the old buffer DONATED into the same-shaped
+            # update output on donation-capable backends
+            # (executor.write_draws; shape-matching is what makes the
+            # donation actually alias, unlike a growing concat).
+            param_draws = write_draws(param_draws, pd, w_ofs)
+            w_draws = write_draws(w_draws, wd, w_ofs)
+        if kind != "fill":
+            it = start + n
+
+    def quarantine_check(b, finite):
+        """fault_policy="quarantine" at one boundary: classify newly
+        non-finite subsets (already-dead ones are expected to stay
+        non-finite and are ignored) into retries and exhausted
+        deaths. Raises :class:`_QuarantineRewind` when any subset has
+        retry budget left — the loop rewinds the chunk; with only
+        deaths, falls through so the run continues degraded (the
+        dead subsets' draws stay non-finite and the combine-side
+        survival mask drops them)."""
+        bad = (~finite.astype(bool)) & (~dead)
+        if not bad.any():
+            return
+        retried, dropped = [], []
+        for j in np.where(bad)[0]:
+            attempts[j] += 1
+            if attempts[j] > cfg.fault_max_retries:
+                dropped.append(int(j))
+            else:
+                retried.append(int(j))
+        deferred = []
+        if retried:
+            # a rewind replays the WHOLE chunk from its held state —
+            # an exhausted subset therefore gets an (un-forked)
+            # replay for free. Death is DEFERRED, not finalized: if
+            # the fault was transient and the subset's chain recovers
+            # on the replay, finalizing now would report a subset as
+            # dropped whose draws end finite — the accounting
+            # (pstats/bench/manifest) must never contradict the data
+            # (api derives the combine mask from grid finiteness).
+            # A deterministic fault simply recurs on the replay and
+            # dies at the next boundary with no retries pending.
+            deferred, dropped = dropped, []
+        elif dropped and b["index"] == len(plan) - 1:
+            # terminal boundary: no later chunk exists for a NaN
+            # carry to poison, so "dead" is real only if the fault
+            # reached the RECORDED draws — a final-sweep state fault
+            # landing after the last kept draw must not brand a
+            # subset whose data is fine (same
+            # accounting-matches-data invariant as deferral, at the
+            # one boundary with no replay to re-verdict). One (K,)
+            # reduce over the accumulators, paid at most once.
+            with explicit_d2h("terminal_guard", nbytes=k):
+                draws_ok = np.asarray(
+                    _subset_draws_finite(param_draws, w_draws)
+                )
+            spared = [j for j in dropped if draws_ok[j]]
+            if spared:
+                deferred += spared
+                dropped = [j for j in dropped if not draws_ok[j]]
+        for j in dropped:
+            dead[j] = True
+        warnings.warn(
+            "subset state non-finite in subsets "
+            f"{retried + dropped + deferred} at iteration {b['it']} "
+            "(fault_policy='quarantine'): "
+            f"retrying {retried or 'none'} from their chunk-start "
+            f"state with forked keys; dropping {dropped or 'none'} "
+            f"(retry ladder of {cfg.fault_max_retries} exhausted)"
+            + (
+                f"; death of {deferred} deferred pending the replay"
+                if deferred else ""
+            ),
+            RuntimeWarning,
+            stacklevel=3,
+        )
+        if pstats is not None:
+            pstats.record_fault(
+                chunk=b["index"], iteration=b["it"], phase=b["phase"],
+                retried=retried, dropped=dropped, deferred=deferred,
+                attempts={
+                    j: int(attempts[j])
+                    for j in retried + dropped + deferred
+                },
+            )
+        if retried:
+            mask = np.zeros(k, bool)
+            mask[retried] = True
+            raise _QuarantineRewind(mask)
 
     def boundary_host_work(b, stall):
         """Guard + report + checkpoint for one completed chunk.
@@ -975,15 +1374,25 @@ def fit_subsets_chunked(
             with explicit_d2h("chunk_stats", nbytes=stats_bytes):
                 finite = np.asarray(b["stats"][0])
                 accept = float(np.asarray(b["stats"][1]))
-            if nan_guard and not finite.all():
+            if policy_q:
+                # quarantine replaces the abort guard wholesale: a
+                # rewind skips this boundary's report AND save (the
+                # chunk is being redone), a death falls through
+                quarantine_check(b, finite)
+            elif nan_guard and not finite.all():
                 if ck is not None and writer is not None:
                     # earlier checkpoints must land before the raise:
                     # the error's contract is "the last checkpoint
                     # precedes the failure"
                     writer.flush()
                 raise SubsetNaNError(np.where(~finite)[0], b["it"])
-            report(b["phase"], b["it"], b["window_start"], accept)
-        if ck is not None:
+            if b["phase"] != "fill":
+                # refill chunks run PAST n_samples at hole offsets —
+                # feeding them to the user progress callback would
+                # break its documented contract (phases burn/sample,
+                # iteration <= n_samples, monotone progress)
+                report(b["phase"], b["it"], b["window_start"], accept)
+        if ck is not None and b["save"]:
             ck.save(
                 b["state_src"], b["seg_src"], b["it"], b["filled"]
             )
@@ -1000,10 +1409,14 @@ def fit_subsets_chunked(
     def boundary_record(index, kind, start, n, dispatch_s):
         """Capture everything chunk (start, n)'s host work needs,
         snapshotting device outputs so the later (possibly
-        background) consumption is donation-safe."""
+        background) consumption is donation-safe. Refill chunks
+        ("fill") record no checkpoint sources: their out-of-order
+        draw writes deliberately skip the per-boundary append path
+        (segments must stay contiguous) — the post-refill
+        rewrite_full publishes them in one merged segment."""
         nonlocal state
         it_end = start + n
-        phase = "burn" if kind == "burn" else "sample"
+        phase = {"burn": "burn", "fill": "fill"}.get(kind, "sample")
         stats = _chunk_stats(state) if want_stats else None
         if stats is not None and mode == "overlap":
             for leaf in stats:
@@ -1023,7 +1436,7 @@ def fit_subsets_chunked(
         filled = max(0, it_end - n_burn)
         state_src = seg_src = None
         d2h = stats_bytes if stats is not None else 0
-        if ck is not None:
+        if ck is not None and kind != "fill":
             if mode == "overlap":
                 state_src = HostSnapshot(state)
                 d2h += state_src.nbytes
@@ -1046,55 +1459,100 @@ def fit_subsets_chunked(
             "window_start": 0 if kind == "burn" else n_burn,
             "stats": stats, "state_src": state_src,
             "seg_src": seg_src, "filled": filled,
+            "save": kind != "fill",
             "dispatch_s": dispatch_s, "d2h_bytes": d2h,
         }
 
+    def apply_rewind(b, rw):
+        """Rewind one faulted chunk: restore its held chunk-start
+        state with forked keys + tightened steps on the retried
+        subsets (the K-1 others get their exact start state back, so
+        the replayed chunk reproduces their outputs bit-identically
+        — share-nothing purity), and move the iteration clock back.
+        The replay re-dispatches the SAME cached compiled program:
+        zero recompiles across quarantine transitions."""
+        nonlocal state, it
+        state = refork(
+            b["held"],
+            jnp.asarray(rw.retry_mask),
+            jnp.asarray(attempts, jnp.int32),
+        )
+        if b["phase"] != "fill":
+            it = b["start"]
+
+    # One loop drives both pipeline modes AND the quarantine rewind:
+    # plan entries are dispatched by index; "sync" processes each
+    # boundary immediately (stall=True), "overlap" processes boundary
+    # t while chunk t+1 computes, then drains the last boundary. A
+    # _QuarantineRewind from a boundary resets the plan index to the
+    # faulted chunk (discarding any in-flight successor — its draw
+    # rows are overwritten on replay) and re-runs from the held
+    # state. With fault_policy="abort" this executes exactly the
+    # historical schedule: same dispatches, same boundary order.
     try:
-        if mode == "overlap":
-            pending = None
-            for index, (kind, start, n) in enumerate(plan):
+        idx = 0
+        pending = None
+        while True:
+            if idx < len(plan):
+                kind, start, n, w_ofs = plan[idx]
                 t0 = time.perf_counter()
-                dispatch(kind, start, n)
+                held = _held_clone(state) if policy_q else None
+                dispatch(kind, start, n, w_ofs)
                 b = boundary_record(
-                    index, kind, start, n,
+                    idx, kind, start, n,
                     time.perf_counter() - t0,
                 )
-                # chunk index's work is now queued on the device;
-                # the PREVIOUS chunk's host work overlaps it
-                if pending is not None:
-                    boundary_host_work(pending, stall=False)
-                pending = b
-            if pending is not None:
+                b["held"] = held
+                b["start"] = start
+                idx += 1
+                if mode == "overlap":
+                    # chunk idx's work is now queued on the device;
+                    # the PREVIOUS chunk's host work overlaps it
+                    todo, pending, stall = pending, b, False
+                else:
+                    todo, stall = b, True
+            elif pending is not None:
                 # terminal drain: no next chunk in flight, so this
                 # host work is genuine stall
-                boundary_host_work(pending, stall=True)
-            if ck is not None:
-                t0 = time.perf_counter()
-                ck.ensure_synced(state, it, max(0, it - n_burn))
-                if pstats is not None:
-                    pstats.record_chunk(
-                        chunk=len(plan), phase="drain", n_iters=0,
-                        iteration=it, dispatch_s=0.0,
-                        host_work_s=time.perf_counter() - t0,
-                        host_stall_s=time.perf_counter() - t0,
-                        d2h_bytes=0,
-                    )
-        else:
-            for index, (kind, start, n) in enumerate(plan):
-                t0 = time.perf_counter()
-                dispatch(kind, start, n)
-                b = boundary_record(
-                    index, kind, start, n,
-                    time.perf_counter() - t0,
+                todo, pending, stall = pending, None, True
+            else:
+                break
+            if todo is None:
+                continue
+            try:
+                boundary_host_work(todo, stall=stall)
+            except _QuarantineRewind as rw:
+                apply_rewind(todo, rw)
+                idx = todo["index"]
+                pending = None
+        if ck is not None and mode == "overlap":
+            t0 = time.perf_counter()
+            ck.ensure_synced(state, it, max(0, it - n_burn))
+            if pstats is not None:
+                pstats.record_chunk(
+                    chunk=len(plan), phase="drain", n_iters=0,
+                    iteration=it, dispatch_s=0.0,
+                    host_work_s=time.perf_counter() - t0,
+                    host_stall_s=time.perf_counter() - t0,
+                    d2h_bytes=0,
                 )
-                boundary_host_work(b, stall=True)
+        if holes and not truncated and ck is not None:
+            # lenient resume refilled one or more corrupt segments'
+            # ranges out of order — publish the complete draw region
+            # as ONE merged, checksummed segment + fresh manifest
+            param_np, w_np = _fetch_draws_slice(
+                param_draws, w_draws, n_kept
+            )
+            ck.rewrite_full(
+                state, param_np, w_np, cfg.n_samples, n_kept
+            )
     finally:
         if writer is not None:
             writer.close()
         if pstats is not None:
             pstats.total_wall_s = time.perf_counter() - t_loop0
 
-    if truncated and it < cfg.n_samples:
+    if truncated:
         return None
 
     finalize = _cached_program(

@@ -1,0 +1,7 @@
+"""Test-only instrumentation for the SMK framework.
+
+``smk_tpu.testing.faults`` is the deterministic chaos-injection
+harness (ISSUE 7). Nothing in here may be imported from ``smk_tpu``
+library code — smklint rule SMK108 enforces that the injectors are
+referenced only under ``tests/`` and ``scripts/``.
+"""

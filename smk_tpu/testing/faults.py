@@ -1,0 +1,294 @@
+"""Deterministic chaos-injection harness (ISSUE 7).
+
+Every injector here is ARMED ONLY INSIDE ITS CONTEXT MANAGER: the
+library patches stay inert no-ops unless a ``with`` block holds the
+arming state, injections fire at exactly the configured
+chunk/job/segment (no wall-clock, no randomness), and the protocol in
+``scripts/chaos_probe.py`` replays bit-identically. The harness
+exists to prove the fault-isolation engine's contracts
+(``SMKConfig.fault_policy``, parallel/recovery.py) against REAL
+faults, not mocks: a NaN planted in a subset's carried state travels
+the genuine quarantine/retry/drop path, a failed writer job travels
+the genuine degrade path, a flipped bit travels the genuine
+checksum/lenient-resume path.
+
+Injectors:
+
+- :func:`inject_subset_nan` — NaN a chosen subset's latent state at
+  the chunk boundary covering a chosen global iteration (fires a
+  configurable number of times, so retries can be made to succeed or
+  exhaust deterministically).
+- :func:`fail_writer_job` — make the Nth ``BackgroundWriter`` job of
+  the scope raise (the overlap pipeline's write-failure path,
+  including the final-chunk hole).
+- :func:`corrupt_segment` — truncate or bit-flip an on-disk v6 draw
+  segment (plain file surgery; deterministic byte positions).
+- :func:`kill_at_manifest` — raise :class:`SimulatedKill` from the
+  Nth manifest write of the scope, simulating a mid-boundary kill in
+  the crash window AFTER the segment landed and BEFORE the manifest
+  published it.
+
+smklint rule SMK108: these APIs may be imported/armed only under
+``tests/`` and ``scripts/`` — a reference in ``smk_tpu/`` library
+code ships chaos to production fits and is a lint finding.
+
+:func:`inject_subset_nan` wraps the executor's per-dispatch program
+LOOKUP (``recovery._cached_program``), not the compiled programs
+themselves: the model's program cache keeps only clean executables,
+warm models from earlier uninjected runs are injectable, and exiting
+the context leaves zero residue anywhere.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from smk_tpu.parallel import recovery as _recovery
+from smk_tpu.utils import checkpoint as _checkpoint
+from smk_tpu.utils.checkpoint import segment_path
+
+
+class ChaosError(RuntimeError):
+    """The injected failure of :func:`fail_writer_job`."""
+
+
+class SimulatedKill(RuntimeError):
+    """The injected mid-boundary kill of :func:`kill_at_manifest`."""
+
+
+_arm_lock = threading.Lock()
+
+
+# ---------------------------------------------------------------------------
+# subset-NaN injection
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SubsetNaNInjection:
+    """Arming state of :func:`inject_subset_nan` (also the handle the
+    context manager yields — ``fires`` counts how often it struck).
+    ``skip_fires`` window hits are let through untouched before the
+    first strike — the lever for timing a fault onto a specific
+    RETRY pass of a chunk (the quarantine engine replays the same
+    iteration window, so pass number == window-hit count)."""
+
+    subset: int
+    at_iteration: int
+    max_fires: int = 1
+    skip_fires: int = 0
+    fires: int = 0
+    skipped: int = 0
+    fired_at: list = field(default_factory=list)
+
+
+# several injections may be armed at once (nested context managers) —
+# e.g. a deterministic fault in one subset timed to co-occur with a
+# first fault in another, the retry-deferral scenario
+_active_nan: list[SubsetNaNInjection] = []
+_nan_patched = False
+
+
+@jax.jit
+def _poison(state, subset):
+    """NaN subset ``subset``'s latent GP draw — one element of one of
+    the small carried leaves the boundary guard covers, so the fault
+    is detected at the very boundary it is planted on."""
+    return state._replace(u=state.u.at[subset].set(jnp.nan))
+
+
+def _ensure_nan_patched() -> None:
+    global _nan_patched
+    with _arm_lock:
+        if _nan_patched:
+            return
+        real = _recovery._cached_program
+
+        def looking_up(model, key, build):
+            fn = real(model, key, build)
+            # wrap ONLY chunk programs, ONLY while armed, and ONLY at
+            # lookup time — the model's cache holds the clean
+            # executable, so warm models inject and disarmed runs are
+            # byte-for-byte untouched
+            if not _active_nan or key[0] not in ("burn", "samp"):
+                return fn
+            kind, length = key[0], key[1]
+
+            def wrapped(data, state, it):
+                out = fn(data, state, it)
+                if not _active_nan:
+                    return out
+                start = int(np.asarray(it))
+                hits = []
+                for inj in list(_active_nan):
+                    if not (
+                        start <= inj.at_iteration < start + length
+                    ) or inj.fires >= inj.max_fires:
+                        continue
+                    if inj.skipped < inj.skip_fires:
+                        inj.skipped += 1
+                        continue
+                    inj.fires += 1
+                    inj.fired_at.append(start)
+                    hits.append(inj.subset)
+                if not hits:
+                    return out
+                if kind == "samp":
+                    state_out, draws = out
+                    for j in hits:
+                        state_out = _poison(state_out, j)
+                    return state_out, draws
+                for j in hits:
+                    out = _poison(out, j)
+                return out
+
+            return wrapped
+
+        _recovery._cached_program = looking_up
+        _nan_patched = True
+
+
+@contextmanager
+def inject_subset_nan(
+    subset: int,
+    at_iteration: int,
+    max_fires: int = 1,
+    skip_fires: int = 0,
+):
+    """Arm a subset-NaN injection: the chunk whose iteration range
+    covers ``at_iteration`` returns its carried state with subset
+    ``subset``'s latent draw poisoned to NaN, ``max_fires`` times
+    after letting ``skip_fires`` window hits through (retries of the
+    same chunk re-enter the window — ``max_fires=1`` lets the first
+    retry succeed, a large value exhausts the retry ladder
+    deterministically, and ``skip_fires`` times a fault onto a later
+    retry pass). Context managers NEST: several injections may be
+    armed at once, each with its own schedule. Yields the injection
+    record."""
+    _ensure_nan_patched()
+    inj = SubsetNaNInjection(
+        subset=int(subset),
+        at_iteration=int(at_iteration),
+        max_fires=int(max_fires),
+        skip_fires=int(skip_fires),
+    )
+    with _arm_lock:
+        _active_nan.append(inj)
+    try:
+        yield inj
+    finally:
+        with _arm_lock:
+            _active_nan.remove(inj)
+
+
+# ---------------------------------------------------------------------------
+# BackgroundWriter job failure
+# ---------------------------------------------------------------------------
+
+
+@contextmanager
+def fail_writer_job(nth: int, exc: BaseException | None = None):
+    """Arm the writer-failure injector: the ``nth`` job (1-based,
+    counted across ALL BackgroundWriter instances in the scope)
+    raises ``exc`` (default :class:`ChaosError`) when the writer
+    thread executes it. Yields a counter dict (``{"submitted": n}``).
+    """
+    real = _checkpoint.BackgroundWriter.submit
+    counter = {"submitted": 0}
+
+    def patched(self, job):
+        counter["submitted"] += 1
+        if counter["submitted"] == nth:
+            def boom():
+                raise exc or ChaosError(
+                    f"chaos: injected failure of writer job {nth}"
+                )
+
+            return real(self, boom)
+        return real(self, job)
+
+    _checkpoint.BackgroundWriter.submit = patched
+    try:
+        yield counter
+    finally:
+        _checkpoint.BackgroundWriter.submit = real
+
+
+# ---------------------------------------------------------------------------
+# on-disk segment corruption
+# ---------------------------------------------------------------------------
+
+
+def corrupt_segment(
+    path: str, index: int, mode: str = "bitflip"
+) -> str:
+    """Damage the draw segment ``index`` of the checkpoint at
+    ``path`` deterministically: ``"truncate"`` keeps only the first
+    half of the file (np.load then fails structurally);
+    ``"bitflip"`` flips one bit in the middle of the param payload
+    and rewrites the file with the now-stale integrity stamp — the
+    zip stays perfectly readable and ONLY the v6 payload checksum
+    (utils/checkpoint.segment_checksum) can catch it, which is the
+    scenario the checksum exists for (a raw mid-file flip can land in
+    zip alignment padding and change nothing). Returns the segment
+    file path. Plain file surgery — no arming needed, but test-only
+    by SMK108 all the same."""
+    seg = segment_path(path, index)
+    if mode == "truncate":
+        with open(seg, "rb") as f:
+            data = f.read()
+        with open(seg, "wb") as f:
+            f.write(data[: len(data) // 2])
+    elif mode == "bitflip":
+        with np.load(seg) as d:
+            arrays = {k: d[k] for k in d.files}
+        param = arrays["param"]
+        raw = bytearray(param.tobytes())
+        raw[len(raw) // 2] ^= 0x40
+        arrays["param"] = np.frombuffer(
+            bytes(raw), param.dtype
+        ).reshape(param.shape)
+        with open(seg, "wb") as f:
+            np.savez(f, **arrays)  # stale "crc" member rides along
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    return seg
+
+
+# ---------------------------------------------------------------------------
+# mid-boundary kill
+# ---------------------------------------------------------------------------
+
+
+@contextmanager
+def kill_at_manifest(nth: int):
+    """Arm the kill injector: the ``nth`` checkpoint-manifest write
+    of the scope (1-based, across instances) raises
+    :class:`SimulatedKill` — the segment of that boundary has already
+    landed, the manifest has not, which is exactly the crash window
+    the v6 layout's ordering contract protects. In "sync" mode the
+    kill unwinds the executor like a process death the atomic-rename
+    design survives; in "overlap" it lands in the writer thread and
+    exercises the degrade path instead."""
+    real = _recovery._SegmentedCheckpoint._write_manifest
+    counter = {"writes": 0}
+
+    def patched(self, state_np, it, fault=None):
+        counter["writes"] += 1
+        if counter["writes"] == nth:
+            raise SimulatedKill(
+                f"chaos: simulated kill at manifest write {nth}"
+            )
+        return real(self, state_np, it, fault)
+
+    _recovery._SegmentedCheckpoint._write_manifest = patched
+    try:
+        yield counter
+    finally:
+        _recovery._SegmentedCheckpoint._write_manifest = real

@@ -8,7 +8,8 @@ with their treedef recorded, so resume = load + continue the scan, and
 a failed shard is recoverable by re-running just that subset (the fit
 is a pure function of (data slice, key)).
 
-Since checkpoint format v5 (parallel/recovery.py) the chunked
+Since checkpoint format v5 (now v6 with per-segment integrity
+checksums — parallel/recovery.py) the chunked
 executor's draws no longer ride in the manifest: each chunk boundary
 appends one SEGMENT file holding only that chunk's new kept draws
 (:func:`save_segment` / :func:`load_segment`), so per-boundary I/O is
@@ -25,15 +26,25 @@ import json
 import os
 import queue
 import threading
+import warnings
+import zlib
 from typing import Any, Callable, Optional
 
 import jax
 import numpy as np
 
 
-def _is_key(leaf: Any) -> bool:
+def is_key_leaf(leaf: Any) -> bool:
+    """True when ``leaf`` is a typed jax PRNG key array — the ONE
+    definition of the dtype probe every serialization/clone/refork
+    site shares (checkpoint save/load, recovery's state clone and
+    quarantine key fork), so a jax key-dtype change is a one-line
+    fix. Trace-static: the dtype is concrete even under jit."""
     dt = getattr(leaf, "dtype", None)
     return dt is not None and jax.dtypes.issubdtype(dt, jax.dtypes.prng_key)
+
+
+_is_key = is_key_leaf  # backwards-compatible private alias
 
 
 def save_pytree(path: str, tree: Any) -> int:
@@ -69,12 +80,26 @@ def _atomic_savez(path: str, arrays: dict) -> int:
 
 
 def segment_path(path: str, index: int) -> str:
-    """On-disk name of draw segment ``index`` of the v5 checkpoint at
+    """On-disk name of draw segment ``index`` of the segmented checkpoint at
     ``path`` (the manifest). Deterministic so a resumed run OVERWRITES
     any orphan segment a killed predecessor left at the same index —
     the manifest is always written after its segments, so it never
     references stale content."""
     return f"{path}.seg{index:05d}.npz"
+
+
+def segment_checksum(
+    param_draws: np.ndarray, w_draws: np.ndarray, start: int, stop: int
+) -> int:
+    """CRC32 over a segment's payload bytes AND its recorded range —
+    the integrity stamp format v6 writes into every segment. An npz
+    whose zip structure survives a bit flip (np.savez stores arrays
+    uncompressed, so most flips land silently in array data) still
+    fails this check, and a truncated file fails np.load before it —
+    either way resume sees a corrupt segment, not silent garbage."""
+    h = zlib.crc32(np.asarray([start, stop], np.int64).tobytes())
+    h = zlib.crc32(np.ascontiguousarray(param_draws).tobytes(), h)
+    return zlib.crc32(np.ascontiguousarray(w_draws).tobytes(), h)
 
 
 def save_segment(
@@ -85,29 +110,51 @@ def save_segment(
     start: int,
     stop: int,
 ) -> int:
-    """Write one v5 draw segment: the kept-draw slices covering filled
-    iterations [start, stop). Atomic; returns bytes written."""
+    """Write one draw segment: the kept-draw slices covering filled
+    iterations [start, stop), stamped with its payload checksum
+    (format v6). Atomic; returns bytes written."""
+    param_draws = np.asarray(param_draws)
+    w_draws = np.asarray(w_draws)
     return _atomic_savez(
         segment_path(path, index),
         {
-            "param": np.asarray(param_draws),
-            "w": np.asarray(w_draws),
+            "param": param_draws,
+            "w": w_draws,
             "start": np.asarray([start], np.int64),
             "stop": np.asarray([stop], np.int64),
+            "crc": np.asarray(
+                [segment_checksum(param_draws, w_draws, start, stop)],
+                np.uint32,
+            ),
         },
     )
 
 
 def load_segment(path: str, index: int) -> dict:
-    """Read one v5 draw segment written by :func:`save_segment`."""
+    """Read one draw segment written by :func:`save_segment`,
+    verifying the v6 payload checksum when present (a v5-era segment
+    without one loads unchecked — resume's shape/contiguity checks
+    still apply). Raises ValueError on checksum mismatch."""
     seg = segment_path(path, index)
     with np.load(seg) as data:
-        return {
+        out = {
             "param": data["param"],
             "w": data["w"],
             "start": int(data["start"][0]),
             "stop": int(data["stop"][0]),
         }
+        if "crc" in data.files:
+            want = int(data["crc"][0])
+            got = segment_checksum(
+                out["param"], out["w"], out["start"], out["stop"]
+            )
+            if got != want:
+                raise ValueError(
+                    f"draw segment {seg} failed its integrity "
+                    f"checksum (stored {want:#010x}, recomputed "
+                    f"{got:#010x}) — the file is corrupt"
+                )
+    return out
 
 
 class BackgroundWriter:
@@ -124,11 +171,22 @@ class BackgroundWriter:
     publish a manifest whose segment never landed); the caller
     observes ``error`` at the next chunk boundary and degrades to
     synchronous writes (parallel/recovery.py).
+
+    Last-chunk hole (ISSUE 7): a job that fails on the FINAL boundary
+    has no next boundary at which the error check runs, and an
+    exception unwinding the executor reaches only the ``finally:
+    close()``. ``close()`` therefore WARNS if the recorded error was
+    never acknowledged (``acknowledge_error``) — a failed terminal
+    checkpoint write can end the run silently no longer; the
+    executor's normal completion path instead drains the writer,
+    acknowledges, and rewrites a full consistent checkpoint inline
+    (``_SegmentedCheckpoint.ensure_synced``).
     """
 
     def __init__(self, name: str = "smk-ckpt-writer"):
         self._q: queue.Queue = queue.Queue()
         self._error: Optional[BaseException] = None
+        self._error_acked = False
         self._thread = threading.Thread(
             target=self._loop, name=name, daemon=True
         )
@@ -139,6 +197,14 @@ class BackgroundWriter:
     def error(self) -> Optional[BaseException]:
         """First exception raised by a job, or None. Stays set: a
         writer that failed once never executes another job."""
+        return self._error
+
+    def acknowledge_error(self) -> Optional[BaseException]:
+        """Mark the recorded error as surfaced to the user (the
+        degrade/recovery paths call this); returns it. Unacknowledged
+        errors are warned about at ``close()``."""
+        if self._error is not None:
+            self._error_acked = True
         return self._error
 
     def submit(self, job: Callable[[], None]) -> None:
@@ -157,7 +223,9 @@ class BackgroundWriter:
             self._q.join()
 
     def close(self) -> None:
-        """Flush and stop the thread. Idempotent."""
+        """Flush and stop the thread. Idempotent. Warns if a job
+        failed and nothing ever surfaced the error — the last-chunk
+        failure window where no later boundary exists to notice."""
         if self._closed:
             return
         self._closed = True
@@ -165,6 +233,18 @@ class BackgroundWriter:
             self._q.join()
             self._q.put(None)
             self._thread.join()
+        if self._error is not None and not self._error_acked:
+            self._error_acked = True
+            warnings.warn(
+                f"background checkpoint writer failed ({self._error!r})"
+                " and the run ended before any boundary could surface "
+                "it — the checkpoint on disk may be missing its final "
+                "boundary (earlier writes are consistent: the writer "
+                "skips all jobs after a failure); re-run or resume to "
+                "re-establish it",
+                RuntimeWarning,
+                stacklevel=2,
+            )
 
     def _loop(self) -> None:
         while True:

@@ -109,10 +109,19 @@ class ChunkPipelineStats:
     per-boundary bytes O(chunk), flat in the iteration counter — is
     directly measurable (scripts/async_pipe_probe.py,
     ASYNC_PIPE_*.jsonl).
+
+    Fault accounting (ISSUE 7, ``fault_policy="quarantine"``): one
+    ``record_fault`` entry per quarantine event — which subsets were
+    rewound/relaunched (``retried``), which exhausted their retry
+    ladder and were dropped (``dropped``), and the per-subset attempt
+    counts at that moment — so a bench record or protocol can report
+    the full retry history, not just the survivor set.
     """
 
     mode: str = "sync"
+    fault_policy: str = "abort"
     chunks: List[Dict[str, Any]] = field(default_factory=list)
+    fault_events: List[Dict[str, Any]] = field(default_factory=list)
     ckpt_write_s: float = 0.0
     ckpt_bytes: int = 0
     ckpt_boundary_bytes: List[int] = field(default_factory=list)
@@ -123,6 +132,36 @@ class ChunkPipelineStats:
 
     def record_chunk(self, **entry: Any) -> None:
         self.chunks.append(entry)
+
+    def record_fault(
+        self,
+        *,
+        chunk: int,
+        iteration: int,
+        phase: str,
+        retried: List[int],
+        dropped: List[int],
+        attempts: Dict[int, int],
+        deferred: List[int] = (),
+    ) -> None:
+        """One quarantine event (parallel/recovery.py): at ``chunk``'s
+        boundary (global ``iteration``), ``retried`` subsets were
+        rewound to their chunk-start state and relaunched with forked
+        keys; ``dropped`` subsets exhausted fault_max_retries and are
+        dead from here on; ``deferred`` subsets exhausted their budget
+        at a boundary that also rewound — their death is pending the
+        replay (a transient fault may recover there, a deterministic
+        one dies at the next boundary). ``attempts`` maps each
+        involved subset to its attempt count so far."""
+        self.fault_events.append({
+            "chunk": int(chunk),
+            "iteration": int(iteration),
+            "phase": phase,
+            "retried": [int(j) for j in retried],
+            "dropped": [int(j) for j in dropped],
+            "deferred": [int(j) for j in deferred],
+            "attempts": {int(j): int(n) for j, n in attempts.items()},
+        })
 
     def add_ckpt_write(self, seconds: float, nbytes: int) -> None:
         with self._lock:
@@ -156,6 +195,31 @@ class ChunkPipelineStats:
             "overlap_efficiency": (
                 round(1.0 - stall / wall, 4) if wall > 0 else 1.0
             ),
+            # ISSUE 7 fault-isolation accounting: policy, retry
+            # ladder history, and the final dropped-subset set —
+            # JSON-friendly (string subset ids) for bench/protocol
+            # records
+            "fault": self.fault_summary(),
+        }
+
+    def fault_summary(self) -> Dict[str, Any]:
+        """The retry-ladder history compressed for a bench record."""
+        attempts: Dict[int, int] = {}
+        dropped: List[int] = []
+        retries = 0
+        for ev in self.fault_events:
+            retries += len(ev["retried"])
+            dropped.extend(ev["dropped"])
+            for j, n in ev["attempts"].items():
+                attempts[j] = max(attempts.get(j, 0), n)
+        return {
+            "policy": self.fault_policy,
+            "n_events": len(self.fault_events),
+            "retries_total": retries,
+            "subsets_dropped": sorted(set(dropped)),
+            "retry_attempts": {
+                str(j): attempts[j] for j in sorted(attempts)
+            },
         }
 
 

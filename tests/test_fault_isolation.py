@@ -1,0 +1,609 @@
+"""Fault-isolation engine tests (ISSUE 7): quarantine/retry/drop
+semantics of SMKConfig.fault_policy, the v6 checksummed checkpoint's
+lenient hole-refill resume, the degraded combine, and the exact
+preservation of the historical "abort" contract.
+
+Sizes are deliberately tiny (m=16, 24 iterations, chunk_iters=4 —
+ONE burn + ONE sampling program shape for the whole file) and all
+fits share module-scoped model instances, so compiled chunk programs
+are paid once (recovery's per-model program cache) and warm fits are
+sub-second. The scale-independent engine logic is what's under test;
+the protocol-grade evidence lives in scripts/chaos_probe.py
+(FAULTS_r09.jsonl). Expensive overlap-pipeline/api legs are
+slow-marked per the tier-1 870 s window.
+"""
+
+# smklint: test-budget=m=16 fits on shared warm models (one compile set for the file); each unmarked test measures ~1-6 s on CPU
+
+import dataclasses
+import os
+import shutil
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from smk_tpu.config import SMKConfig
+from smk_tpu.models.probit_gp import SpatialProbitGP
+from smk_tpu.parallel.combine import (
+    SubsetSurvivalError,
+    apply_survival_mask,
+    combine_quantile_grids,
+)
+from smk_tpu.parallel.partition import random_partition
+from smk_tpu.parallel.recovery import (
+    SubsetNaNError,
+    find_failed_subsets,
+    fit_subsets_chunked,
+)
+from smk_tpu.testing.faults import (
+    ChaosError,
+    SimulatedKill,
+    corrupt_segment,
+    fail_writer_job,
+    inject_subset_nan,
+    kill_at_manifest,
+)
+from smk_tpu.utils.checkpoint import (
+    load_segment,
+    save_segment,
+    segment_path,
+)
+from smk_tpu.utils.tracing import ChunkPipelineStats
+
+K = 4
+CFG = SMKConfig(
+    n_subsets=K, n_samples=24, burn_in_frac=0.5, phi_update_every=2,
+)
+CHUNK = 4  # 3 burn + 3 sampling chunks; segments cover [0,4),[4,8),[8,12)
+
+
+@pytest.fixture(scope="module")
+def problem():
+    rng = np.random.default_rng(7)
+    n, q, p, t = 64, 1, 2, 3
+    coords = jnp.asarray(rng.uniform(size=(n, 2)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(n, q, p)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, size=(n, q)), jnp.float32)
+    ct = jnp.asarray(rng.uniform(size=(t, 2)), jnp.float32)
+    xt = jnp.asarray(rng.normal(size=(t, q, p)), jnp.float32)
+    part = random_partition(jax.random.key(0), y, x, coords, K)
+    return part, ct, xt, jax.random.key(1)
+
+
+@pytest.fixture(scope="module")
+def models():
+    """One model per (pipeline, policy) combination used below —
+    chunk programs cache on the instance, so every fit after the
+    first with a given shape is compile-free."""
+    def mk(mode, policy):
+        return SpatialProbitGP(
+            dataclasses.replace(
+                CFG, chunk_pipeline=mode, fault_policy=policy
+            ),
+            weight=1,
+        )
+
+    return {
+        ("sync", "quarantine"): mk("sync", "quarantine"),
+        ("sync", "abort"): mk("sync", "abort"),
+        ("overlap", "quarantine"): mk("overlap", "quarantine"),
+    }
+
+
+def run(problem, models, mode="sync", policy="quarantine", path=None,
+        **kw):
+    part, ct, xt, key = problem
+    return fit_subsets_chunked(
+        models[(mode, policy)], part, ct, xt, key,
+        chunk_iters=CHUNK, checkpoint_path=path, **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def golden(problem, models, tmp_path_factory):
+    """The uninjected sync/quarantine reference run, checkpointed (the
+    on-disk v6 layout doubles as the corruption-test substrate via
+    per-test copies)."""
+    path = str(tmp_path_factory.mktemp("golden") / "g.npz")
+    res = run(problem, models, path=path)
+    return res, path
+
+
+def _copy_ckpt(src, dst_dir, n_segments=3):
+    os.makedirs(dst_dir, exist_ok=True)
+    dst = os.path.join(dst_dir, os.path.basename(src))
+    shutil.copy(src, dst)
+    for i in range(n_segments):
+        shutil.copy(segment_path(src, i), segment_path(dst, i))
+    return dst
+
+
+class TestNoFaultParity:
+    def test_quarantine_bit_identical_to_abort(
+        self, problem, models, golden, tmp_path
+    ):
+        """The golden pin: with no faults, fault_policy="quarantine"
+        produces BIT-identical draws to "abort" — the engine only
+        clones the carried state per chunk and never touches the
+        chunk programs (the XLA-module-context bit-identity
+        contract)."""
+        ref, _ = golden
+        res = run(
+            problem, models, policy="abort",
+            path=str(tmp_path / "a.npz"),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ref.param_samples), np.asarray(res.param_samples)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ref.w_samples), np.asarray(res.w_samples)
+        )
+
+    def test_abort_policy_raises_exact_subset_nan_error(
+        self, problem, models, tmp_path
+    ):
+        """The historical contract survives: under "abort" +
+        nan_guard an injected NaN raises SubsetNaNError naming the
+        shard, before any checkpoint lands."""
+        path = str(tmp_path / "n.npz")
+        with pytest.raises(SubsetNaNError) as ei:
+            with inject_subset_nan(2, 14):
+                run(
+                    problem, models, policy="abort", path=path,
+                    nan_guard=True,
+                )
+        assert ei.value.subset_ids == [2]
+        assert ei.value.iteration == 16  # the boundary covering it 14
+
+
+class TestQuarantineRetry:
+    def test_retry_succeeds_and_survivors_bit_identical(
+        self, problem, models, golden
+    ):
+        """A one-shot NaN in subset 2 mid-sampling: the run completes,
+        subset 2 is rewound/relaunched with a forked key (its chain
+        legitimately differs from the golden one), and the other K-1
+        subsets are BIT-identical to the uninjected run — the
+        share-nothing replay contract."""
+        ref, _ = golden
+        ps = ChunkPipelineStats()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            with inject_subset_nan(2, 14, max_fires=1) as inj:
+                res = run(problem, models, pipeline_stats=ps)
+        assert inj.fires == 1
+        rp, ip = np.asarray(ref.param_samples), np.asarray(
+            res.param_samples
+        )
+        others = [j for j in range(K) if j != 2]
+        np.testing.assert_array_equal(rp[others], ip[others])
+        assert np.isfinite(ip[2]).all()
+        assert not np.array_equal(rp[2], ip[2])
+        assert find_failed_subsets(res).size == 0
+        f = ps.fault_summary()
+        assert f["policy"] == "quarantine"
+        assert f["retries_total"] == 1
+        assert f["subsets_dropped"] == []
+        assert f["retry_attempts"] == {"2": 1}
+
+    def test_zero_recompiles_across_quarantine_transitions(
+        self, problem, models
+    ):
+        """On a warm model, a full NaN -> rewind -> replay -> recover
+        cycle performs ZERO XLA backend compiles: the replay
+        re-dispatches the cached chunk program and the refork/clone
+        helpers are shape-stable (verified with
+        analysis/sanitizers.recompile_guard, per the acceptance
+        criteria)."""
+        from smk_tpu.analysis.sanitizers import recompile_guard
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            with inject_subset_nan(2, 14, max_fires=1):
+                warm = run(problem, models)  # pays any cold compiles
+            with recompile_guard(
+                0, label="warm quarantine run with fault transitions"
+            ):
+                with inject_subset_nan(2, 14, max_fires=1):
+                    replay = run(problem, models)
+        np.testing.assert_array_equal(
+            np.asarray(warm.param_samples),
+            np.asarray(replay.param_samples),
+        )
+
+    def test_retry_exhaustion_drops_subset_and_degrades_combine(
+        self, problem, models, golden
+    ):
+        """A persistent fault exhausts the retry ladder
+        (fault_max_retries=2 -> 3 attempts), the subset dies, the run
+        still completes with the survivors bit-identical, and the
+        combine drops exactly that subset — hard-failing only when
+        min_surviving_frac demands more survivors than exist."""
+        ref, _ = golden
+        ps = ChunkPipelineStats()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            with inject_subset_nan(1, 14, max_fires=99) as inj:
+                res = run(problem, models, pipeline_stats=ps)
+        assert inj.fires == 1 + CFG.fault_max_retries
+        dead = find_failed_subsets(res)
+        np.testing.assert_array_equal(dead, [1])
+        f = ps.fault_summary()
+        assert f["subsets_dropped"] == [1]
+        assert f["retries_total"] == CFG.fault_max_retries
+        assert f["retry_attempts"] == {"1": 1 + CFG.fault_max_retries}
+        survivors = [j for j in range(K) if j != 1]
+        np.testing.assert_array_equal(
+            np.asarray(ref.param_samples)[survivors],
+            np.asarray(res.param_samples)[survivors],
+        )
+        # degraded combine over the survivors is finite for both
+        # combiners; the dead subset's NaN curve never poisons it
+        mask = np.ones(K, bool)
+        mask[dead] = False
+        for method in ("wasserstein_mean", "weiszfeld_median"):
+            out = combine_quantile_grids(
+                res.param_grid, method, survival_mask=mask,
+                min_surviving_frac=0.5,
+            )
+            assert np.isfinite(np.asarray(out)).all()
+        # ... and the contract fails loudly below min_surviving_frac
+        with pytest.raises(SubsetSurvivalError) as ei:
+            combine_quantile_grids(
+                res.param_grid, "wasserstein_mean",
+                survival_mask=mask, min_surviving_frac=0.95,
+            )
+        assert ei.value.n_surviving == 3
+        assert ei.value.n_total == K
+
+
+class TestDeferredDeath:
+    def test_transient_fault_recovering_on_corewind_is_not_dropped(
+        self, problem, models, golden
+    ):
+        """Review hardening: a subset whose retry budget runs out at a
+        boundary that ALSO rewinds (another subset still retrying)
+        gets the replay for free — if its fault was transient and the
+        chain recovers, it must NOT be reported dropped (the
+        accounting would contradict the finite data the combine sees).
+        Schedule: subset 1 faults on passes 1-3 (budget 2 exhausted on
+        pass 3), subset 2's single fault is timed onto pass 3 — the
+        co-rewind replays pass 4 clean and BOTH chains finish."""
+        ref, _ = golden
+        ps = ChunkPipelineStats()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            with inject_subset_nan(1, 14, max_fires=3):
+                with inject_subset_nan(2, 14, max_fires=1,
+                                       skip_fires=2):
+                    res = run(problem, models, pipeline_stats=ps)
+        ip = np.asarray(res.param_samples)
+        assert np.isfinite(ip).all()
+        assert find_failed_subsets(res).size == 0
+        f = ps.fault_summary()
+        assert f["subsets_dropped"] == []  # consistent with the data
+        assert f["retry_attempts"] == {"1": 3, "2": 1}
+        deferred = [e["deferred"] for e in ps.fault_events
+                    if e["deferred"]]
+        assert deferred == [[1]]
+        # the untouched subsets are still bit-identical
+        np.testing.assert_array_equal(
+            np.asarray(ref.param_samples)[[0, 3]], ip[[0, 3]]
+        )
+
+    def test_deterministic_fault_still_dies_after_deferral(
+        self, problem, models
+    ):
+        """The other arm: a deterministic fault recurs on the
+        deferred replay and dies at the next boundary — deferral
+        delays the verdict by one replay, never waives it."""
+        ps = ChunkPipelineStats()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            with inject_subset_nan(1, 14, max_fires=99):
+                with inject_subset_nan(2, 14, max_fires=1,
+                                       skip_fires=2):
+                    res = run(problem, models, pipeline_stats=ps)
+        f = ps.fault_summary()
+        assert f["subsets_dropped"] == [1]
+        np.testing.assert_array_equal(find_failed_subsets(res), [1])
+        assert f["retry_attempts"]["1"] == 4  # 3 budget passes + 1 deferred replay
+
+    def test_terminal_state_fault_with_finite_draws_is_spared(
+        self, problem, models, golden
+    ):
+        """Review hardening: a fault that poisons only the carried
+        STATE at the very last boundary — after the final kept draw
+        was recorded — must not brand the subset dead: there is no
+        later chunk for the NaN carry to poison, its data is finite,
+        and dropping it in pstats/manifest would contradict the
+        combine the api performs on grid finiteness. The injector
+        models exactly this (it poisons the returned state, never the
+        chunk's draws), so an unlimited injection in the FINAL
+        chunk's window exhausts the ladder with finite draws
+        throughout."""
+        ref, _ = golden
+        ps = ChunkPipelineStats()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            # final sampling chunk covers iterations [20, 24)
+            with inject_subset_nan(2, 22, max_fires=99) as inj:
+                res = run(problem, models, pipeline_stats=ps)
+        assert inj.fires == 1 + CFG.fault_max_retries
+        ip = np.asarray(res.param_samples)
+        assert np.isfinite(ip).all()
+        assert find_failed_subsets(res).size == 0
+        f = ps.fault_summary()
+        assert f["subsets_dropped"] == []  # spared: data is finite
+        assert f["retries_total"] == CFG.fault_max_retries
+        spared = [e["deferred"] for e in ps.fault_events
+                  if e["deferred"]]
+        assert spared == [[2]]
+        # the other subsets never even noticed
+        np.testing.assert_array_equal(
+            np.asarray(ref.param_samples)[[0, 1, 3]], ip[[0, 1, 3]]
+        )
+
+
+class TestCorruptSegmentResume:
+    def test_bitflip_hole_is_resampled(
+        self, problem, models, golden, tmp_path
+    ):
+        """A bit-flipped middle segment (payload checksum catches it)
+        resumes under quarantine: rows outside the hole are
+        bit-identical to the original run, the hole's range [4, 8) is
+        re-sampled finite by extending the chain, and the terminal
+        rewrite leaves a clean checkpoint (second resume is silent
+        and bit-identical)."""
+        ref, gpath = golden
+        path = _copy_ckpt(gpath, str(tmp_path / "flip"))
+        corrupt_segment(path, 1, "bitflip")
+        with pytest.warns(RuntimeWarning, match="re-sampled"):
+            res = run(problem, models, path=path)
+        fp, sp = np.asarray(ref.param_samples), np.asarray(
+            res.param_samples
+        )
+        assert np.isfinite(sp).all()
+        np.testing.assert_array_equal(fp[:, :4], sp[:, :4])
+        np.testing.assert_array_equal(fp[:, 8:], sp[:, 8:])
+        assert not np.array_equal(fp[:, 4:8], sp[:, 4:8])
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # any warning now fails
+            again = run(problem, models, path=path)
+        np.testing.assert_array_equal(
+            sp, np.asarray(again.param_samples)
+        )
+
+    def test_abort_policy_rejects_corruption_loudly(
+        self, problem, models, golden, tmp_path
+    ):
+        """The same damage under "abort" is a resume-killing
+        ValueError naming the segment — lenient resampling is opt-in
+        via the policy, never silent default behavior."""
+        _, gpath = golden
+        for mode, match in (
+            ("bitflip", "corrupt draw segment"),
+            ("truncate", "corrupt draw segment"),
+        ):
+            path = _copy_ckpt(gpath, str(tmp_path / mode))
+            corrupt_segment(path, 1, mode)
+            with pytest.raises(ValueError, match=match):
+                run(problem, models, policy="abort", path=path)
+
+
+class TestOverlapAndWriterLegs:
+    # slow-marked: these legs re-compile the overlap pipeline's
+    # programs and run 3 extra fits — the sync-mode coverage above
+    # carries the same engine logic in-gate
+    @pytest.mark.slow
+    def test_overlap_quarantine_bit_identical_and_retries(
+        self, problem, models, golden, tmp_path
+    ):
+        """The quarantine engine under chunk_pipeline="overlap":
+        no-fault bit-identity with the sync golden run, and an
+        injected fault (detected one chunk late, while the successor
+        is in flight) still rewinds/replays correctly — the in-flight
+        chunk is discarded and its rows overwritten."""
+        ref, _ = golden
+        res = run(
+            problem, models, mode="overlap",
+            path=str(tmp_path / "ov.npz"),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ref.param_samples),
+            np.asarray(res.param_samples),
+        )
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            with inject_subset_nan(2, 14, max_fires=1) as inj:
+                inj_res = run(problem, models, mode="overlap")
+        assert inj.fires == 1
+        ip = np.asarray(inj_res.param_samples)
+        others = [j for j in range(K) if j != 2]
+        np.testing.assert_array_equal(
+            np.asarray(ref.param_samples)[others], ip[others]
+        )
+        assert np.isfinite(ip).all()
+
+    @pytest.mark.slow
+    def test_final_chunk_writer_failure_surfaces_and_recovers(
+        self, problem, models, tmp_path
+    ):
+        """Satellite regression (the last-chunk hole): a background
+        writer job that fails on the FINAL boundary has no next
+        boundary — the end-of-run drain must still surface a warning
+        and rewrite a consistent terminal checkpoint (resuming it
+        immediately returns the identical completed result)."""
+        path = str(tmp_path / "w.npz")
+        with pytest.warns(
+            RuntimeWarning, match="background checkpoint writer"
+        ):
+            # 6 boundaries -> job 6 is the terminal save
+            with fail_writer_job(6):
+                res = run(problem, models, mode="overlap", path=path)
+        again = run(problem, models, mode="overlap", path=path)
+        np.testing.assert_array_equal(
+            np.asarray(res.param_samples),
+            np.asarray(again.param_samples),
+        )
+
+    @pytest.mark.slow
+    def test_manifest_kill_crash_window_resumes(
+        self, problem, models, golden, tmp_path
+    ):
+        """A simulated kill between a segment landing and its
+        manifest write (the v6 crash window) leaves the previous
+        consistent view; resume completes bit-identically."""
+        ref, _ = golden
+        path = str(tmp_path / "k.npz")
+        with pytest.raises(SimulatedKill):
+            with kill_at_manifest(3):
+                run(problem, models, path=path)
+        res = run(problem, models, path=path)
+        np.testing.assert_array_equal(
+            np.asarray(ref.param_samples),
+            np.asarray(res.param_samples),
+        )
+
+    @pytest.mark.slow
+    def test_api_stamps_subsets_dropped(self, problem):
+        """fit_meta_kriging end to end under quarantine: a subset
+        whose retries exhaust is dropped, subsets_dropped lands in
+        the result, and the combined grids are finite."""
+        from smk_tpu.api import fit_meta_kriging
+
+        rng = np.random.default_rng(3)
+        n, q, p, t = 64, 1, 2, 3
+        y = rng.integers(0, 2, size=(n, q)).astype(np.float32)
+        x = rng.normal(size=(n, q, p)).astype(np.float32)
+        coords = rng.uniform(size=(n, 2)).astype(np.float32)
+        ct = rng.uniform(size=(t, 2)).astype(np.float32)
+        xt = rng.normal(size=(t, q, p)).astype(np.float32)
+        cfg = dataclasses.replace(
+            CFG, fault_policy="quarantine", n_quantiles=20,
+            resample_size=50, min_surviving_frac=0.5,
+        )
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            with inject_subset_nan(0, 14, max_fires=99):
+                res = fit_meta_kriging(
+                    jax.random.key(0), y, x, coords, ct, xt,
+                    config=cfg, chunk_iters=CHUNK,
+                )
+        assert res.subsets_dropped == (0,)
+        assert np.isfinite(np.asarray(res.param_grid)).all()
+        assert np.isfinite(np.asarray(res.p_samples)).all()
+
+
+class TestUnits:
+    """Pure host-side units: no sampler, no compiles."""
+
+    def test_segment_checksum_roundtrip_and_detection(self, tmp_path):
+        path = str(tmp_path / "c.npz")
+        p = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        w = np.ones((2, 3, 5), np.float32)
+        save_segment(path, 0, p, w, 0, 3)
+        seg = load_segment(path, 0)  # checksum-clean
+        np.testing.assert_array_equal(seg["param"], p)
+        corrupt_segment(path, 0, "bitflip")
+        with pytest.raises(ValueError, match="integrity checksum"):
+            load_segment(path, 0)
+
+    def test_truncated_segment_fails_structurally(self, tmp_path):
+        import zipfile
+
+        path = str(tmp_path / "t.npz")
+        save_segment(
+            path, 0, np.zeros((2, 3, 4), np.float32),
+            np.zeros((2, 3, 5), np.float32), 0, 3,
+        )
+        corrupt_segment(path, 0, "truncate")
+        with pytest.raises((zipfile.BadZipFile, OSError, ValueError)):
+            load_segment(path, 0)
+
+    def test_apply_survival_mask(self):
+        grids = jnp.asarray(
+            np.arange(4 * 5 * 2, dtype=np.float32).reshape(4, 5, 2)
+        )
+        mask = np.array([True, False, True, True])
+        out = apply_survival_mask(grids, mask)
+        np.testing.assert_array_equal(
+            np.asarray(out), np.asarray(grids)[[0, 2, 3]]
+        )
+        # all-True returns the input untouched (bit-identity for
+        # fault-free runs)
+        assert apply_survival_mask(grids, np.ones(4, bool)) is grids
+        with pytest.raises(SubsetSurvivalError):
+            apply_survival_mask(
+                grids, mask, min_surviving_frac=0.9
+            )
+        with pytest.raises(ValueError, match="entries"):
+            apply_survival_mask(grids, np.ones(3, bool))
+
+    def test_fault_summary_aggregation(self):
+        ps = ChunkPipelineStats(fault_policy="quarantine")
+        ps.record_fault(
+            chunk=3, iteration=16, phase="sample", retried=[2],
+            dropped=[], attempts={2: 1},
+        )
+        ps.record_fault(
+            chunk=3, iteration=16, phase="sample", retried=[],
+            dropped=[2], attempts={2: 2},
+        )
+        f = ps.fault_summary()
+        assert f == {
+            "policy": "quarantine", "n_events": 2,
+            "retries_total": 1, "subsets_dropped": [2],
+            "retry_attempts": {"2": 2},
+        }
+        assert ps.aggregate()["fault"] == f
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="fault_policy"):
+            SMKConfig(fault_policy="panic")
+        with pytest.raises(ValueError, match="fault_max_retries"):
+            SMKConfig(fault_max_retries=-1)
+        with pytest.raises(ValueError, match="min_surviving_frac"):
+            SMKConfig(min_surviving_frac=0.0)
+        # R-double coercion covers the new int field
+        assert SMKConfig(fault_max_retries=3.0).fault_max_retries == 3
+
+    def test_writer_failure_injector_is_scoped(self, tmp_path):
+        """fail_writer_job patches submit only inside the context."""
+        from smk_tpu.utils.checkpoint import BackgroundWriter
+
+        done = []
+        with fail_writer_job(1):
+            w = BackgroundWriter()
+            w.submit(lambda: done.append(1))
+            w.flush()
+            assert isinstance(w.error, ChaosError)
+            w.acknowledge_error()
+            w.close()
+        w2 = BackgroundWriter()
+        w2.submit(lambda: done.append(2))
+        w2.close()
+        assert done == [2]
+
+    def test_unacknowledged_writer_error_warns_at_close(self):
+        """Satellite: a failed job whose error nothing surfaced warns
+        when the writer shuts down (the silent-loss backstop for
+        exception-unwind paths)."""
+        from smk_tpu.utils.checkpoint import BackgroundWriter
+
+        w = BackgroundWriter()
+        w.submit(lambda: (_ for _ in ()).throw(OSError("disk full")))
+        w.flush()
+        with pytest.warns(RuntimeWarning, match="ended before any"):
+            w.close()
+        # acknowledged errors close silently
+        w2 = BackgroundWriter()
+        w2.submit(lambda: (_ for _ in ()).throw(OSError("x")))
+        w2.flush()
+        w2.acknowledge_error()
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            w2.close()

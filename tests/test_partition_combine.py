@@ -105,6 +105,48 @@ class TestCombine:
         med = np.asarray(weiszfeld_median(grids))
         assert (np.diff(med, axis=0) >= -1e-5).all()
 
+    def test_weiszfeld_outlier_median_vs_mean(self):
+        """ISSUE 7 satellite: one outlier curve among K=5 — the
+        geometric median must essentially ignore it while the
+        barycenter (mean) is dragged by outlier/K."""
+        rng = np.random.default_rng(11)
+        base = np.sort(rng.normal(size=(40, 1)), axis=0).astype(np.float32)
+        grids = np.stack(
+            [base + rng.normal(scale=0.005, size=(40, 1)).astype(np.float32)
+             for _ in range(4)] + [base + 50.0]
+        )
+        med = np.asarray(weiszfeld_median(jnp.asarray(grids), n_iter=100))
+        mean = np.asarray(wasserstein_barycenter(jnp.asarray(grids)))
+        assert np.abs(med - base).mean() < 0.1  # median ignores it
+        assert np.abs(mean - base).mean() > 5.0  # mean does not (50/5)
+
+    def test_weiszfeld_coincidence_guard(self):
+        """The Vardi–Zhang guard: when the iterate lands ON a subset
+        curve (here: duplicated curves force it), the old 1/sqrt(eps)
+        weight spike must not stall the fixed point away from the true
+        median, and the result stays finite and monotone."""
+        rng = np.random.default_rng(12)
+        base = np.sort(rng.normal(size=(30, 1)), axis=0).astype(np.float32)
+        # 3 identical copies of the true median curve + 2 symmetric
+        # flankers: the median IS `base`, and the iterate coincides
+        # with it from the very first step (init = mean = base)
+        grids = jnp.asarray(np.stack(
+            [base, base, base, base - 1.0, base + 1.0]
+        ))
+        med = np.asarray(weiszfeld_median(grids, n_iter=60))
+        assert np.isfinite(med).all()
+        np.testing.assert_allclose(med, base, atol=1e-4)
+        assert (np.diff(med, axis=0) >= -1e-5).all()
+        # a coincident NON-optimal start must escape: median of
+        # 4 clustered curves + the iterate starting elsewhere still
+        # converges into the cluster
+        grids2 = jnp.asarray(np.stack(
+            [base + 0.2, base + 0.21, base + 0.19, base + 0.2,
+             base + 5.0]
+        ))
+        med2 = np.asarray(weiszfeld_median(grids2, n_iter=100))
+        assert np.abs(med2 - (base + 0.2)).mean() < 0.05
+
     def test_dispatch(self):
         grids = jnp.asarray(
             np.sort(np.random.default_rng(5).normal(size=(4, 30, 2)), 1), jnp.float32

@@ -480,6 +480,67 @@ class TestSuppressions:
         assert rules_hit(src, path=DATA_PATH) == ["SMK100"]
 
 
+class TestFaultInjectionZone:
+    """SMK108 (ISSUE 7): chaos APIs are test/script-only."""
+
+    IMPORT_FORMS = [
+        "from smk_tpu.testing.faults import inject_subset_nan\n",
+        "from smk_tpu.testing import faults\n",
+        "import smk_tpu.testing.faults as chaos\n",
+        "import importlib\n"
+        "f = importlib.import_module('smk_tpu.testing.faults')\n",
+        "from ..testing.faults import corrupt_segment\n",
+        # the package-attribute spellings (review hardening: these
+        # were the evasion the first cut of the rule missed)
+        "from smk_tpu import testing\n",
+        "from smk_tpu import config, testing\n",
+        "from .. import testing\n",
+    ]
+
+    @pytest.mark.parametrize("src", IMPORT_FORMS)
+    def test_injector_reference_in_library_code_flagged(self, src):
+        assert "SMK108" in rules_hit(src, path=MODELS_PATH)
+        assert "SMK108" in rules_hit(
+            src, path="smk_tpu/parallel/fixture.py"
+        )
+
+    @pytest.mark.parametrize("src", IMPORT_FORMS[:3])
+    def test_tests_scripts_and_harness_itself_exempt(self, src):
+        assert "SMK108" not in rules_hit(src, path=TESTS_PATH)
+        assert "SMK108" not in rules_hit(src, path=SCRIPT_PATH)
+        assert "SMK108" not in rules_hit(
+            src, path="smk_tpu/testing/fixture.py"
+        )
+        assert "SMK108" not in rules_hit(src, path="bench.py")
+
+    def test_unrelated_testing_module_not_flagged(self):
+        # only smk_tpu.testing is the chaos zone — a third-party
+        # "testing" package is someone else's business
+        src = "from numpy import testing\nimport testing.tools\n"
+        assert "SMK108" not in rules_hit(src, path=MODELS_PATH)
+
+    def test_justified_suppression_respected(self):
+        src = (
+            "# smklint: disable=SMK108 -- fixture exercising the rule itself\n"
+            "from smk_tpu.testing import faults\n"
+        )
+        assert "SMK108" not in rules_hit(src, path=MODELS_PATH)
+
+    def test_real_harness_and_consumers_clean(self):
+        """The shipped chaos harness lints clean, and the REAL
+        library modules it patches contain no reference back to it
+        (the seeded-defect direction: pasting an injector import into
+        recovery.py must be caught)."""
+        real = "smk_tpu/parallel/recovery.py"
+        src = repo_file(real)
+        assert "SMK108" not in rules_hit(src, path=real)
+        broken = (
+            "from smk_tpu.testing.faults import inject_subset_nan\n"
+            + src
+        )
+        assert "SMK108" in rules_hit(broken, path=real)
+
+
 class TestTreeGate:
     def test_repo_lints_clean(self):
         """The acceptance gate as a tier-1 test: zero unsuppressed
@@ -536,7 +597,7 @@ class TestTreeGate:
 
 @pytest.mark.parametrize("rule_id", [
     "SMK101", "SMK102", "SMK103", "SMK104", "SMK105", "SMK106",
-    "SMK107",
+    "SMK107", "SMK108",
 ])
 def test_every_rule_documented_in_catalogue(rule_id):
     from smk_tpu.analysis.lint import _list_rules
