@@ -61,6 +61,12 @@ is retried from its chunk-start state and dropped after
 SMKConfig.fault_max_retries; the rung record stamps fault_policy,
 retry counts and subsets_dropped (fault-free runs are bit-identical
 across policies, so the default never changes measured chains).
+BENCH_COMPILE_STORE=<dir> routes every public chunked rung through
+the AOT program store (ISSUE 8): programs are built via
+lower().compile() and serialized there, a warm directory serves them
+back with zero backend compiles, and the rung record stamps
+program_sources + the measured acquisition seconds
+(pipeline.compile_s). Draws are bit-identical with the store on/off.
 
 Synthetic latent surfaces use random Fourier features (an O(n)
 stationary GP approximation) so data generation never needs an n x n
@@ -83,25 +89,13 @@ import numpy as np
 # tunnel cost 20-90 s per program and the ladder compiles ~10 programs
 # — across bench runs on the same machine the cache turns that ~300 s
 # of the budget into near-zero. Keyed by HLO + jaxlib + device, so a
-# solver-config change recompiles exactly what changed.
-try:  # pragma: no cover - environment-dependent
-    import tempfile
+# solver-config change recompiles exactly what changed. One shared
+# helper (BENCH_CACHE_DIR override + per-user tempdir default +
+# swallow-on-failure, as always) — smk_tpu/compile/xla_cache.py is
+# the single source of truth for this config (smklint SMK109).
+from smk_tpu.compile.xla_cache import enable_persistent_cache
 
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.environ.get(
-            "BENCH_CACHE_DIR",
-            # per-user path: a world-shared /tmp name could be squatted
-            # (unwritable -> silently no cache) or pre-populated by
-            # another user (deserialized executables)
-            os.path.join(
-                tempfile.gettempdir(), f"smk_jax_cache_{os.getuid()}"
-            ),
-        ),
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-except Exception:
-    pass
+enable_persistent_cache()
 
 BASELINE_TARGET_S = 600.0
 
@@ -479,6 +473,13 @@ def rung_config(env, *, k, n_samples, cov_model, link, n_chains=1,
         # + degraded combine) instead of aborting; fault-free chains
         # are bit-identical across policies
         fault_policy=env.get("BENCH_FAULT_POLICY", "abort"),
+        # AOT program store (ISSUE 8): BENCH_COMPILE_STORE=<dir> makes
+        # every public chunked rung build its programs ahead of time
+        # and serialize them there — a warm directory turns the
+        # rung's compile_s into deserialization and stamps
+        # program_sources={"l2": ...} (draws bit-identical either
+        # way; empty/unset = off, the historical in-dispatch compile)
+        compile_store_dir=env.get("BENCH_COMPILE_STORE") or None,
         chol_block_size=int(env.get("BENCH_CHOL_BLOCK", 0)),
         # blocked-GEMM trisolves with carried panel inverses: XLA's
         # native trisolve is latency-bound at these shapes (measured
@@ -794,6 +795,12 @@ def run_rung_public(name, *, n, k, cov_model, n_samples, q=1, p=2,
         "fault_policy": cfg.fault_policy,
         "fault_retries": fault["retries_total"],
         "subsets_dropped": fault["subsets_dropped"],
+        # ISSUE 8: where this rung's compiled programs came from
+        # (l1/l2/l3/fresh acquisition telemetry; pipeline.compile_s
+        # is the measured acquisition time, while the top-level
+        # compile_s above remains the wall-decomposition estimate)
+        "compile_store": cfg.compile_store_dir,
+        "program_sources": pstats.program_summary()["program_sources"],
     }
     return rung_diagnostics(
         record, res, cfg, m=m, k=k, q=q, p_dim=p, n_samples=n_samples,

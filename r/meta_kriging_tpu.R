@@ -66,6 +66,7 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
                                 fault.max.retries = 2L,
                                 n.report = NULL,
                                 checkpoint.path = NULL,
+                                compile.store.dir = NULL,
                                 backend = c("tpu", "cpu"),
                                 seed = 0L,
                                 python_path = NULL,
@@ -126,6 +127,16 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
   # than min_surviving_frac (config.overrides, default 0.5) of the
   # n.core subsets survive. Fault-free fits are bit-identical across
   # policies; see the README's "Fault tolerance" section.
+  # compile.store.dir: directory of the AOT program store (ISSUE 8,
+  # smk_tpu/compile/). The first fit at a given shape builds its
+  # compiled programs ahead of time and serializes them there; every
+  # later fit — INCLUDING in a fresh R session — loads them instead
+  # of recompiling, so a warm deployment skips the one-time XLA
+  # compile (historically ~120 s at large shapes, more than the fit
+  # itself). Draws are bit-identical with the store on or off; a
+  # stale (different jax/device) or corrupt artifact is rebuilt with
+  # a warning, never mis-loaded. Implies the chunked executor (see
+  # the README's "AOT & compile caching" section).
   k.prior <- match.arg(k.prior)
   phi.proposal.family <- match.arg(phi.proposal.family)
   fused.build <- match.arg(fused.build)
@@ -182,6 +193,7 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
     chunk_pipeline = chunk.pipeline,
     fault_policy = fault.policy,
     fault_max_retries = as.integer(fault.max.retries),
+    compile_store_dir = compile.store.dir,
     priors = smk$PriorConfig(a_prior = k.prior)
   ), config.overrides)
   cfg <- do.call(smk$SMKConfig, cfg_args)

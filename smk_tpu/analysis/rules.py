@@ -1,4 +1,4 @@
-"""smklint rules SMK101–SMK106 — the repo's JAX invariants, each one
+"""smklint rules SMK101–SMK109 — the repo's JAX invariants, each one
 traceable to the PR that established it (see analysis/RULES.md).
 
 All rules are pure-AST (no jax import). Shared machinery:
@@ -1100,6 +1100,71 @@ class FaultInjectionZoneRule(Rule):
                         )
 
 
+# ---------------------------------------------------------------------------
+# SMK109 — compile-cache config goes through smk_tpu/compile/
+# ---------------------------------------------------------------------------
+
+# The config keys the shared helper (smk_tpu/compile/xla_cache.py)
+# owns. Assembled from parts so this module's own AST never contains
+# the literal inside a call expression the rule would flag.
+_CACHE_KEY_EXACT = "jax_compilation" + "_cache_dir"
+_CACHE_KEY_PREFIX = "jax_persistent" + "_cache_"
+
+
+class CompileCacheConfigRule(Rule):
+    id = "SMK109"
+    name = "compile-cache-config"
+    doc = (
+        "direct jax.config.update of the persistent compile-cache "
+        "keys (jax_compilation_cache_dir / jax_persistent_cache_*) "
+        "outside smk_tpu/compile/ — the shared helper "
+        "smk_tpu.compile.xla_cache.enable_persistent_cache is the "
+        "one source of truth (ISSUE 8: two private copy-pasted "
+        "blocks kept the cache off the public path for seven PRs)"
+    )
+
+    def applies(self, module):
+        # the helper module itself is the one sanctioned writer
+        return "smk_tpu/compile/" not in module.norm_path()
+
+    @staticmethod
+    def _is_cache_key(value) -> bool:
+        return isinstance(value, str) and (
+            value == _CACHE_KEY_EXACT
+            or value.startswith(_CACHE_KEY_PREFIX)
+        )
+
+    def check(self, module, ctx):
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # any *.update(...) / update(...) spelling — jax.config
+            # may arrive aliased (from jax import config; cfg.update)
+            chain = ()
+            if isinstance(node.func, ast.Attribute):
+                chain = attr_chain(node.func)
+            elif isinstance(node.func, ast.Name):
+                chain = (node.func.id,)
+            if not chain or chain[-1] != "update":
+                continue
+            for arg in list(node.args) + [
+                kw.value for kw in node.keywords
+            ]:
+                if isinstance(arg, ast.Constant) and self._is_cache_key(
+                    arg.value
+                ):
+                    yield self.finding(
+                        module, node,
+                        f"direct config update of {arg.value!r} — "
+                        "the persistent XLA compile cache is armed "
+                        "through smk_tpu.compile.xla_cache."
+                        "enable_persistent_cache only (one source of "
+                        "truth for path resolution, env override and "
+                        "failure handling); call the helper instead",
+                    )
+                    break
+
+
 ALL_RULES = [
     BatchingRuleRule(),
     HostNondeterminismRule(),
@@ -1109,4 +1174,5 @@ ALL_RULES = [
     TestBudgetRule(),
     UnusedImportRule(),
     FaultInjectionZoneRule(),
+    CompileCacheConfigRule(),
 ]

@@ -217,9 +217,26 @@ def fit_meta_kriging(
     stamped into the result, and the fit raises
     parallel.combine.SubsetSurvivalError only when fewer than
     ``config.min_surviving_frac`` of the subsets survive.
+
+    ``config.compile_store_dir`` / ``config.xla_cache_dir`` enable
+    the AOT program store (ISSUE 8, smk_tpu/compile/): the former
+    (L2, implies chunked execution) loads/persists serialized
+    executables so a warm deployment pays zero compile — pair with
+    ``smk_tpu.compile.precompile`` to build them ahead of time; the
+    latter (L3) arms jax's persistent XLA compilation cache. Draws
+    are bit-identical with the store on or off (a loaded executable
+    is the same machine code the building process ran).
     """
     cfg = config or SMKConfig()
     times = PhaseTimes()
+    # L3 of the AOT program store (ISSUE 8): arm jax's persistent XLA
+    # compilation cache when the config names a directory — the same
+    # cache bench.py always used privately, now on the public path
+    # through the one shared helper (smk_tpu/compile/xla_cache.py)
+    if cfg.xla_cache_dir is not None:
+        from smk_tpu.compile.xla_cache import maybe_enable_from_config
+
+        maybe_enable_from_config(cfg)
     k_part, k_fit, k_resample = jax.random.split(key, 3)
 
     # Everything downstream computes in cfg.dtype (float64 requires
@@ -295,6 +312,10 @@ def fit_meta_kriging(
             # guard — the policy implies chunked execution just as
             # nan_guard does
             or cfg.fault_policy == "quarantine"
+            # the L2 program store's shape-bucketed programs live in
+            # the chunked executor, which consults the store before
+            # tracing (ISSUE 8) — enabling it implies chunking too
+            or cfg.compile_store_dir is not None
         ):
             from smk_tpu.parallel.recovery import fit_subsets_chunked
 

@@ -1,0 +1,55 @@
+"""smk_tpu.compile — the three-level AOT program store (ISSUE 8).
+
+Kills the public path's cold-compile tax (ROADMAP open item 3:
+compile_s=120.4 > fit_s=70.1 at north-star shapes) with three layers,
+coarsest-cost first:
+
+- **L1** (``programs.get_program``): per-model in-memory FIFO program
+  cache — same-process, same-bucket refits are zero-compile.
+- **L2** (``store.ProgramStore``, ``SMKConfig.compile_store_dir``):
+  serialized executables on disk, built AOT via
+  ``fn.lower(...).compile()`` and fingerprint-guarded — a warm store
+  makes a FRESH PROCESS compile-free, and a reloaded executable's
+  draws are bit-identical to the process that built it.
+- **L3** (``xla_cache.enable_persistent_cache``,
+  ``SMKConfig.xla_cache_dir``): jax's persistent XLA compilation
+  cache, wired into the public API through the one shared helper
+  (smklint SMK109 keeps it the single source of truth).
+
+``warmup.precompile`` lets a deployment pay compile at build time;
+see the README's "AOT & compile caching" section.
+"""
+
+from smk_tpu.compile.programs import (
+    L1_CACHE_MAX,
+    aux_bucket_key,
+    chunk_bucket_key,
+    config_digest,
+    get_program,
+    store_from_config,
+)
+from smk_tpu.compile.store import ProgramStore, env_fingerprint
+from smk_tpu.compile.warmup import chunk_plan_lengths, precompile
+from smk_tpu.compile.xla_cache import (
+    default_cache_dir,
+    enable_persistent_cache,
+    maybe_enable_from_config,
+    persistent_cache_enabled,
+)
+
+__all__ = [
+    "L1_CACHE_MAX",
+    "aux_bucket_key",
+    "chunk_bucket_key",
+    "config_digest",
+    "get_program",
+    "store_from_config",
+    "ProgramStore",
+    "env_fingerprint",
+    "chunk_plan_lengths",
+    "precompile",
+    "default_cache_dir",
+    "enable_persistent_cache",
+    "maybe_enable_from_config",
+    "persistent_cache_enabled",
+]

@@ -1,0 +1,237 @@
+"""Program acquisition: the three-level lookup behind every hot
+compiled program of the chunked executor (ISSUE 8, ROADMAP item 3).
+
+``get_program`` resolves a shape-bucket key through:
+
+- **L1** — the per-model in-memory FIFO cache (the PR 6
+  ``recovery._cached_program`` cache, refactored here): zero-cost
+  same-process reuse; executables die with the model.
+- **L2** — the on-disk :class:`~smk_tpu.compile.store.ProgramStore`
+  (``SMKConfig.compile_store_dir``): programs built AOT via
+  ``fn.lower(...).compile()`` and persisted with
+  ``jax.experimental.serialize_executable``, fingerprint-guarded.
+  A warm store makes a FRESH PROCESS's fit compile-free.
+- **L3** — the persistent XLA compilation cache
+  (``smk_tpu/compile/xla_cache.py``): when armed, a fresh trace's
+  backend compile may be served from disk by XLA itself.
+
+The bucket key is ``(kind, chunk_len, K, chunk_size, m, q, p, t, d,
+n_chains, J, cov_model, link, resolved-fused-build, config-digest)``
+— kind and chunk_len lead so the chaos harness's lookup wrapper
+(smk_tpu/testing/faults.py) keeps identifying chunk programs by
+``key[0]``/``key[1]``, and every data-derived dimension of the
+lowered signature (subset size, responses, covariates, test grid,
+coordinate dim) is explicit because the config digest cannot see
+them. The digest covers every remaining config field
+with the pipeline/fault/compile knobs normalized out (same rationale
+as the checkpoint run-identity hash: those knobs don't change the
+compiled program, so they must not fragment the store).
+
+Telemetry: every acquisition records ``(key, program_source,
+compile_s)`` into the caller's ``ChunkPipelineStats`` —
+``program_source ∈ {"l1", "l2", "l3", "fresh"}`` where ``l3`` means
+"traced+compiled with the persistent XLA cache armed" and ``fresh``
+means no cache anywhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import hashlib
+import os
+import time
+from typing import Optional
+
+from smk_tpu.compile.store import ProgramStore
+from smk_tpu.compile.xla_cache import persistent_cache_enabled
+
+# FIFO bound of the per-model L1 cache: a model driven through a sweep
+# of buckets (varying chunk_iters/K) must not accumulate multi-MB XLA
+# executables forever — a normal run touches <= 4 buckets (burn chunk,
+# sampling chunk, stats, finalize), so evictions only happen under
+# sweeps, where re-acquiring a dropped bucket is the status quo ante.
+L1_CACHE_MAX = 32
+
+# Config fields that never change the compiled chunk program and are
+# therefore normalized out of the bucket digest (exactly the
+# run-identity normalization set of parallel/recovery.py, plus the
+# compile knobs themselves — a store must serve programs to runs that
+# differ only in WHERE they cache).
+_DIGEST_NEUTRAL = dict(
+    chunk_pipeline="sync",
+    fault_policy="abort",
+    fault_max_retries=2,
+    min_surviving_frac=0.5,
+    compile_store_dir=None,
+    xla_cache_dir=None,
+)
+
+
+@functools.lru_cache(maxsize=256)
+def config_digest(cfg) -> str:
+    """Pipeline-invariant digest of the full config: two configs with
+    the same digest trace byte-identical programs at equal shapes
+    (every remaining field — priors, solver, jitter, dtype, ... — is
+    covered by the frozen dataclass repr). Memoized — the executor
+    rebuilds bucket keys per dispatch and must not re-run the
+    dataclasses.replace + repr + sha256 on every chunk of the hot
+    loop (SMKConfig is frozen/hashable, so identity-by-value caching
+    is sound)."""
+    neutral = dataclasses.replace(cfg, **_DIGEST_NEUTRAL)
+    return hashlib.sha256(repr(neutral).encode()).hexdigest()[:12]
+
+
+def chunk_bucket_key(
+    model, kind: str, length: int, k: int,
+    chunk_size: Optional[int], m: int, q: int, p: int, t: int,
+    d: int,
+) -> tuple:
+    """Shape-bucket key of one chunk program. ``kind`` in
+    {"burn", "samp"}; ``length`` is the chunk's iteration count (the
+    only plan-dependent field — ragged tails get their own bucket).
+    EVERY data-derived dimension of the lowered signature rides in
+    the key — subset size ``m``, responses ``q``, covariates ``p``,
+    test locations ``t``, coordinate dim ``d`` — because the config
+    digest cannot see them: a shared store serving two datasets that
+    differ only in p or t must MISS, not hand back an executable
+    lowered for different avals."""
+    cov_model, link, fused, n_chains, j = model.program_bucket_fields()
+    return (
+        kind, length, k, chunk_size, m, q, p, t, d, n_chains, j,
+        cov_model, link, fused, config_digest(model.config),
+    )
+
+
+def aux_bucket_key(model, kind: str, *shape_fields) -> tuple:
+    """Bucket key of a non-chunk hot program (stats guard, finalize,
+    refork): ``kind`` never collides with the chunk kinds, so the
+    chaos harness's chunk-program filter skips these."""
+    cov_model, link, fused, n_chains, j = model.program_bucket_fields()
+    return (
+        (kind,) + tuple(shape_fields)
+        + (n_chains, j, cov_model, link, fused,
+           config_digest(model.config))
+    )
+
+
+def store_from_config(cfg, mesh=None) -> Optional[ProgramStore]:
+    """The L2 store a run should consult: enabled by
+    ``cfg.compile_store_dir``, disabled under an explicit device mesh
+    (a serialized executable bakes in its device assignment; the
+    sharded path keeps L1/L3 — single-device AOT artifacts must not
+    be loaded into, or written from, a mesh-sharded run)."""
+    d = getattr(cfg, "compile_store_dir", None)
+    if not d or mesh is not None:
+        return None
+    return ProgramStore(d)
+
+
+def _record(stats, key, source, compile_s, aot):
+    if stats is None:
+        return
+    rec = getattr(stats, "record_program", None)
+    if rec is not None:
+        rec(key=key, source=source, compile_s=compile_s, aot=aot)
+
+
+def get_program(
+    model,
+    key: tuple,
+    build,
+    *,
+    store: Optional[ProgramStore] = None,
+    lower_args=None,
+    stats=None,
+):
+    """Resolve ``key`` to a callable program via L1 → L2 → build.
+
+    ``build`` returns the jit-wrapped function for this bucket. With a
+    ``store`` and ``lower_args`` (concrete arrays or
+    ``jax.ShapeDtypeStruct`` trees matching the call signature), a
+    store miss compiles AHEAD OF TIME — ``build().lower(*lower_args)
+    .compile()`` — and persists the executable, so the program is off
+    the first-dispatch critical path and the next process deserializes
+    it; without them the jitted function itself is cached and compiles
+    in-dispatch (the historical L1-only behavior, byte-identical).
+
+    Instance storage on the model (not a module-level weak map)
+    because jit closures hold the model strongly — a
+    WeakKeyDictionary whose values reference their key never
+    collects; this way the executables die with the model. Sound
+    because everything a chunk program closes over is frozen at model
+    construction (SMKConfig is a frozen dataclass; weight/fused_build
+    resolve in ``__init__``).
+    """
+    import jax
+
+    per_model = model.__dict__.setdefault("_chunk_programs", {})
+    persisted = model.__dict__.setdefault("_programs_persisted", set())
+
+    def mark_persisted():
+        if store is not None:
+            persisted.add((store.root, key))
+
+    if key in per_model:
+        fn = per_model[key]
+        # L2 backfill: an L1-warm model handed a store for the first
+        # time (the keys are identical by design — the digest
+        # normalizes compile_store_dir out) must still populate the
+        # store, or the "warm deployment" directory stays silently
+        # empty. A lazily-jitted entry is AOT-rebuilt once so the
+        # persisted artifact is a real executable.
+        if (
+            store is not None
+            and lower_args is not None
+            and (store.root, key) not in persisted
+        ):
+            if not os.path.exists(store.path_for(key)):
+                if not isinstance(fn, jax.stages.Compiled):
+                    fn = build().lower(*lower_args).compile()
+                    per_model[key] = fn
+                store.save(key, fn)
+            mark_persisted()
+        _record(stats, key, "l1", 0.0, False)
+        return fn
+
+    def insert(fn):
+        while len(per_model) >= L1_CACHE_MAX:
+            per_model.pop(next(iter(per_model)))
+        per_model[key] = fn
+        return fn
+
+    t0 = time.perf_counter()
+    if lower_args is not None:
+        # AOT path: with a store, consult it first; with or without
+        # one, the program is built by lower().compile() — off the
+        # first-dispatch critical path — so precompile() warms a
+        # process for real even when no store directory is configured
+        compiled = store.load(key) if store is not None else None
+        if compiled is not None:
+            mark_persisted()
+            _record(
+                stats, key, "l2", time.perf_counter() - t0, True
+            )
+            return insert(compiled)
+        compiled = build().lower(*lower_args).compile()
+        compile_s = time.perf_counter() - t0
+        if store is not None:
+            store.save(key, compiled)
+            mark_persisted()
+        _record(
+            stats, key,
+            "l3" if persistent_cache_enabled() else "fresh",
+            compile_s, True,
+        )
+        return insert(compiled)
+
+    # L1-only path: cache the jitted function; XLA compiles inside its
+    # first dispatch (compile_s is therefore not attributable here —
+    # bench's exec_split estimates it from chunk timings instead)
+    fn = build()
+    _record(
+        stats, key,
+        "l3" if persistent_cache_enabled() else "fresh",
+        0.0, False,
+    )
+    return insert(fn)

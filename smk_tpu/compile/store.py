@@ -1,0 +1,177 @@
+"""L2 of the AOT program store: serialized executables on disk.
+
+A :class:`ProgramStore` persists compiled XLA executables — built
+off the first-dispatch critical path via ``fn.lower(...).compile()``
+— with :mod:`jax.experimental.serialize_executable`, keyed by the
+shape-bucket key (``smk_tpu/compile/programs.py``) and guarded by an
+environment fingerprint (jax/jaxlib version, backend, device kind,
+topology). A warm store turns the public chunked executor's ~120 s
+cold-compile tax (README, config5_api_parity: compile_s=120.4 vs
+fit_s=70.1) into a deserialize — and a RELOADED executable is the
+same machine code, so its draws are bit-identical to the process that
+built it (the XLA:CPU module-context bit caveat applies to
+re-COMPILING, never to re-loading; scripts/aot_probe.py pins this).
+
+Integrity contract:
+
+- a fingerprint mismatch (new jaxlib, different device kind, another
+  backend) is a MISS: the artifact is rebuilt and overwritten, never
+  mis-loaded;
+- a corrupt/truncated/unpicklable artifact is a MISS with a one-line
+  RuntimeWarning, never a crash (the store is an accelerator, not a
+  dependency);
+- writes are atomic (tmp + rename), so a killed process can strand at
+  worst a ``.tmp`` orphan, never a half-written artifact at a live
+  path.
+
+Artifacts are pickle files readable only by design from directories
+the caller's own config names (``SMKConfig.compile_store_dir``) —
+treat the store directory with the same trust as the checkpoints next
+to it.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import warnings
+from typing import Any, Optional
+
+STORE_FORMAT = 1
+_SUFFIX = ".smkprog"
+
+
+def env_fingerprint() -> dict:
+    """Everything a serialized executable is only valid under: jax and
+    jaxlib versions, backend platform, device kind, and topology
+    (device/process counts). Compared on every load; any drift makes
+    the artifact stale (rebuilt, never mis-loaded)."""
+    import jax
+
+    devs = jax.devices()
+    return {
+        "format": STORE_FORMAT,
+        "jax": jax.__version__,
+        "jaxlib": jax.lib.__version__,
+        "backend": jax.default_backend(),
+        "device_kind": devs[0].device_kind,
+        "n_devices": len(devs),
+        "process_count": jax.process_count(),
+    }
+
+
+class ProgramStore:
+    """On-disk executable store rooted at one directory.
+
+    ``load``/``save`` round-trip :class:`jax.stages.Compiled` objects
+    through ``serialize_executable``; the bucket key's ``repr`` is
+    stored inside the artifact and compared on load, so a filename
+    hash collision can never hand back the wrong program.
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        try:
+            os.makedirs(root, exist_ok=True)
+        except OSError as e:
+            # the store is an accelerator, not a dependency: a
+            # non-creatable directory degrades to all-miss (loads
+            # find no file, saves warn) instead of killing the fit
+            warnings.warn(
+                f"compile store: could not create store directory "
+                f"{root} ({e!r}); the store will not serve or "
+                "persist programs this run",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+
+    def path_for(self, key: Any) -> str:
+        import hashlib
+
+        h = hashlib.sha256(repr(key).encode()).hexdigest()[:32]
+        return os.path.join(self.root, h + _SUFFIX)
+
+    def load(self, key: Any):
+        """The compiled executable for ``key``, or None on any miss
+        (absent, stale fingerprint, corrupt) — misses warn when there
+        WAS an artifact, so an operator can see why a supposedly warm
+        deployment is compiling."""
+        path = self.path_for(key)
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path, "rb") as f:
+                blob = pickle.load(f)
+            if (
+                blob.get("format") != STORE_FORMAT
+                or blob.get("key") != repr(key)
+            ):
+                raise ValueError(
+                    "artifact format/key mismatch "
+                    f"(format {blob.get('format')!r})"
+                )
+        except Exception as e:
+            warnings.warn(
+                f"compile store: artifact {path} is corrupt or "
+                f"unreadable ({e!r}); rebuilding the program (the "
+                "stale file will be overwritten)",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            return None
+        fp = env_fingerprint()
+        if blob.get("fingerprint") != fp:
+            warnings.warn(
+                f"compile store: artifact {path} was built under a "
+                f"different environment ({blob.get('fingerprint')!r} "
+                f"vs {fp!r}); rebuilding instead of loading a "
+                "possibly-incompatible executable",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            return None
+        try:
+            from jax.experimental import serialize_executable as se
+
+            return se.deserialize_and_load(
+                blob["payload"], blob["in_tree"], blob["out_tree"]
+            )
+        except Exception as e:
+            warnings.warn(
+                f"compile store: artifact {path} failed to "
+                f"deserialize ({e!r}); rebuilding",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            return None
+
+    def save(self, key: Any, compiled) -> Optional[str]:
+        """Serialize ``compiled`` under ``key`` (atomic rename).
+        Failures warn and return None — a read-only store directory
+        must not kill the fit that was trying to warm it."""
+        path = self.path_for(key)
+        try:
+            from jax.experimental import serialize_executable as se
+
+            payload, in_tree, out_tree = se.serialize(compiled)
+            blob = {
+                "format": STORE_FORMAT,
+                "fingerprint": env_fingerprint(),
+                "key": repr(key),
+                "payload": payload,
+                "in_tree": in_tree,
+                "out_tree": out_tree,
+            }
+            tmp = path + f".tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                pickle.dump(blob, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, path)
+            return path
+        except Exception as e:
+            warnings.warn(
+                f"compile store: could not persist program to {path} "
+                f"({e!r}); the in-memory executable is unaffected",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            return None

@@ -1,0 +1,187 @@
+"""``precompile`` — pay the compile tax at build time, not first
+request (ISSUE 8).
+
+A deployment calls :func:`precompile` once per shape bucket (at image
+build, rollout, or instance warm-up) with the model and the run's
+shapes; every hot program of the chunked executor — the burn/sampling
+chunk programs (including ragged tails), the ``_chunk_stats``
+boundary guard, the finalize (kriging/compression) program, and the
+quarantine refork program when ``fault_policy="quarantine"`` — is
+built AOT via ``fn.lower(...).compile()`` and lands in the L1 cache
+and (when a store directory is configured) the L2 on-disk store. The
+subsequent ``fit_meta_kriging``/``fit_subsets_chunked`` then observes
+ZERO XLA backend compiles on its hot loop
+(``analysis/sanitizers.recompile_guard``-pinned in
+tests/test_compile_store.py and scripts/aot_probe.py).
+
+Shapes may be real arrays or ``jax.ShapeDtypeStruct`` trees — nothing
+here executes device math, so a build host can precompile for shapes
+it never holds data for.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from smk_tpu.compile.programs import get_program, store_from_config
+from smk_tpu.compile.store import ProgramStore
+
+
+class _Recorder:
+    """Minimal ``record_program`` sink when the caller passes no
+    ChunkPipelineStats."""
+
+    def __init__(self):
+        self.programs: List[Dict[str, Any]] = []
+
+    def record_program(self, *, key, source, compile_s, aot):
+        self.programs.append({
+            "key": [str(f) for f in key],
+            "source": source,
+            "compile_s": round(float(compile_s), 4),
+            "aot": bool(aot),
+        })
+
+
+def chunk_plan_lengths(
+    n_burn: int, n_samples: int, chunk_iters: int
+) -> List[tuple]:
+    """The distinct ``(kind, length)`` chunk programs the executor's
+    plan dispatches for this budget — full chunks plus ragged tails
+    (each distinct pair is its own compiled program; a tail missed
+    here would compile in-dispatch and defeat the warm-path pin)."""
+    out, seen = [], set()
+    it = 0
+    while it < n_burn:
+        n = min(chunk_iters, n_burn - it)
+        if ("burn", n) not in seen:
+            seen.add(("burn", n))
+            out.append(("burn", n))
+        it += n
+    while it < n_samples:
+        n = min(chunk_iters, n_samples - it)
+        if ("samp", n) not in seen:
+            seen.add(("samp", n))
+            out.append(("samp", n))
+        it += n
+    return out
+
+
+def precompile(
+    model,
+    part,
+    coords_test,
+    x_test,
+    *,
+    chunk_iters: int = 500,
+    chunk_size: Optional[int] = None,
+    store_dir: Optional[str] = None,
+    stats=None,
+) -> Dict[str, Any]:
+    """AOT-build every hot program a chunked fit of these shapes will
+    dispatch.
+
+    ``part``/``coords_test``/``x_test`` carry the shapes (arrays or
+    ``ShapeDtypeStruct``). ``store_dir`` overrides
+    ``model.config.compile_store_dir`` (either enables L2; with
+    neither, programs still land in the model's L1 cache, warming
+    this process only). Returns a report: per-program source
+    ("l2" for already-stored artifacts, "l3"/"fresh" for new builds)
+    and compile seconds.
+    """
+    import jax
+    import numpy as np
+
+    # sampler-specific pieces imported lazily: smk_tpu.compile must
+    # stay importable without pulling the model stack (bench.py arms
+    # the L3 cache via xla_cache before anything heavy loads)
+    from smk_tpu.models.probit_gp import n_params
+    from smk_tpu.parallel import recovery as _rec
+    from smk_tpu.parallel.executor import (
+        stacked_subset_data,
+        subset_chain_keys,
+    )
+
+    cfg = model.config
+    t0 = time.perf_counter()
+    rec = stats if stats is not None else _Recorder()
+    n_before = len(rec.programs)
+    sd = store_dir or getattr(cfg, "compile_store_dir", None)
+    store = ProgramStore(sd) if sd else store_from_config(cfg)
+
+    k = part.n_subsets
+    m, q, p = part.x.shape[1:]
+    t = coords_test.shape[0]
+    d_par = n_params(q, p)
+    d_w = t * q
+    dtype = part.x.dtype
+    data = stacked_subset_data(part, coords_test, x_test)
+    keys = subset_chain_keys(jax.random.key(0), k, cfg.n_chains)
+    state_like = jax.eval_shape(
+        lambda kk, d: _rec._init_states(model, kk, d, None), keys, data
+    )
+    # the executor feeds the chunk-start iteration as a weak-int32
+    # device scalar (jax.device_put of a host int) — lower against the
+    # exact same aval or the stored executable would reject the call
+    it0 = jax.device_put(0)
+
+    d_coord = coords_test.shape[1]
+    for kind, n in chunk_plan_lengths(
+        cfg.n_burn_in, cfg.n_samples, chunk_iters
+    ):
+        get_program(
+            model,
+            _rec._chunk_key(
+                model, kind, n, k, chunk_size, m, q, p, t, d_coord
+            ),
+            lambda kind=kind, n=n: _rec._make_chunk_fn(
+                model, kind, n, k, chunk_size
+            ),
+            store=store, lower_args=(data, state_like, it0),
+            stats=rec,
+        )
+
+    get_program(
+        model, _rec._stats_key(model, k, m, q, p),
+        lambda: _rec._chunk_stats,
+        store=store, lower_args=(state_like,), stats=rec,
+    )
+
+    lead = (k,) if cfg.n_chains == 1 else (k, cfg.n_chains)
+    draws_like = (
+        jax.ShapeDtypeStruct(lead + (cfg.n_kept, d_par), dtype),
+        jax.ShapeDtypeStruct(lead + (cfg.n_kept, d_w), dtype),
+    )
+    get_program(
+        model,
+        _rec._finalize_key(model, k, m, q, cfg.n_kept, d_par, d_w),
+        lambda: jax.jit(jax.vmap(model.finalize)),
+        store=store,
+        lower_args=(state_like,) + draws_like,
+        stats=rec,
+    )
+
+    if cfg.fault_policy == "quarantine":
+        # the quarantine relaunch program: without this, the FIRST
+        # fault on a disk-warm model would compile the refork on the
+        # retry critical path (the recompile_guard-pinned zero)
+        get_program(
+            model, _rec._refork_key(model, k, m, q, p),
+            lambda: _rec._make_refork(cfg.n_chains),
+            store=store,
+            lower_args=(
+                state_like,
+                jax.ShapeDtypeStruct((k,), np.bool_),
+                jax.ShapeDtypeStruct((k,), np.int32),
+            ),
+            stats=rec,
+        )
+
+    programs = rec.programs[n_before:]
+    return {
+        "store_dir": store.root if store is not None else None,
+        "n_programs": len(programs),
+        "programs": programs,
+        "compile_s": round(time.perf_counter() - t0, 4),
+    }

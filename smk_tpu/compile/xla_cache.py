@@ -1,0 +1,94 @@
+"""L3 of the AOT program store: the persistent XLA compilation cache.
+
+One shared helper replaces the two private copy-pasted
+``jax.config.update("jax_compilation_cache_dir", ...)`` blocks that
+used to live in ``bench.py`` and ``__graft_entry__.py`` — the cache
+those blocks armed was invisible to the library users actually import
+(ROADMAP open item 3: the public path pays ~120 s of cold compile the
+bench never sees). This module is the ONE place the repo touches the
+persistent-cache config keys; smklint rule SMK109 flags any direct
+``jax.config.update`` of them outside ``smk_tpu/compile/``.
+
+L3 is the coarsest level of the store: XLA keys the on-disk cache by
+HLO module + jaxlib version + device, so a warm directory turns a
+backend compile into a disk load — but the trace/lowering work and the
+jax-level dispatch-cache miss are still paid, which is why L1 (the
+in-memory per-model program cache) and L2 (serialized executables,
+``smk_tpu/compile/store.py``) sit in front of it.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+# The one persistent-cache tuning knob the old private blocks set: do
+# not burn disk/IO on sub-second compiles.
+MIN_COMPILE_SECS = 1.0
+
+
+def default_cache_dir() -> str:
+    """Per-user path under the system tempdir: a world-shared /tmp
+    name could be squatted (unwritable -> silently no cache) or
+    pre-populated by another user (deserialized executables)."""
+    import tempfile
+
+    return os.path.join(
+        tempfile.gettempdir(), f"smk_jax_cache_{os.getuid()}"
+    )
+
+
+def enable_persistent_cache(
+    cache_dir: Optional[str] = None,
+    *,
+    min_compile_secs: float = MIN_COMPILE_SECS,
+) -> Optional[str]:
+    """Arm jax's persistent on-disk compilation cache.
+
+    ``cache_dir`` resolution order keeps the historical bench behavior
+    byte-for-byte: an explicit argument wins, else the
+    ``BENCH_CACHE_DIR`` environment override, else the per-user
+    tempdir default. Failures are swallowed (exactly like the private
+    blocks this replaces — an unwritable cache dir or an old jax
+    without the key must degrade to "no cache", never kill a run).
+    Returns the resolved directory, or None when arming failed.
+    """
+    try:  # pragma: no cover - environment-dependent
+        import jax
+
+        resolved = (
+            cache_dir
+            or os.environ.get("BENCH_CACHE_DIR")
+            or default_cache_dir()
+        )
+        jax.config.update("jax_compilation_cache_dir", resolved)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            float(min_compile_secs),
+        )
+        return resolved
+    except Exception:
+        return None
+
+
+def persistent_cache_enabled() -> bool:
+    """Whether the persistent XLA cache is currently armed — the
+    telemetry bit that distinguishes ``program_source="l3"`` (a fresh
+    trace whose backend compile may be served from the XLA disk
+    cache) from ``"fresh"`` (no cache anywhere)."""
+    try:
+        import jax
+
+        return bool(jax.config.jax_compilation_cache_dir)
+    except Exception:  # pragma: no cover - config key missing
+        return False
+
+
+def maybe_enable_from_config(cfg) -> Optional[str]:
+    """Public-API wiring: arm L3 when ``SMKConfig.xla_cache_dir`` is
+    set (api.fit_meta_kriging calls this once per fit; re-arming with
+    the same directory is idempotent)."""
+    d = getattr(cfg, "xla_cache_dir", None)
+    if not d:
+        return None
+    return enable_persistent_cache(d)

@@ -309,6 +309,21 @@ class SpatialGPSampler:
         self.fused_build = resolve_fused_build(config.fused_build)
         self._fused = self.fused_build == "pallas"
 
+    def program_bucket_fields(self) -> tuple:
+        """The model-identity fields of every compiled-program bucket
+        key (smk_tpu/compile/programs.py): ``(cov_model, link,
+        resolved_fused_build, n_chains, phi_proposals)``. The fused
+        mode is the RESOLVED one — a config asking for "pallas" on a
+        backend that fell back to the XLA path traces a different
+        program, and an AOT store keyed on the request would hand the
+        wrong executable across environments (the same
+        resolved-not-requested rule bench records follow)."""
+        cfg = self.config
+        return (
+            cfg.cov_model, cfg.link, self.fused_build,
+            cfg.n_chains, cfg.phi_proposals,
+        )
+
     # ------------------------------------------------------------------
     # Correlation builds — the ONE dispatch layer between the sampler
     # and its (m, m)-build kernels. Every method keeps the historical

@@ -25,6 +25,7 @@ import os
 import time
 import warnings
 import zlib
+from functools import partial as _partial
 from typing import Optional, Sequence
 
 import jax
@@ -32,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from smk_tpu.analysis.sanitizers import explicit_d2h
+from smk_tpu.compile import programs as compile_programs
 from smk_tpu.models.probit_gp import (
     SpatialGPSampler,
     SubsetData,
@@ -170,6 +172,25 @@ def _chunk_stats(state):
     golden-pinned to (both modes dispatch the SAME chunk programs;
     this stats program reads, never writes, the carry)."""
     return _finite_subsets(state), jnp.mean(state.phi_accept)
+
+
+@_partial(jax.jit, static_argnames=("n",))
+def _slice_draws(acc, start, n: int):
+    """The boundary checkpoint's kept-draw window — ONE compiled
+    program per (accumulator shape, chunk length) with the offset
+    traced. The python-slice spelling (``acc[..., a:b, :]``) this
+    replaces eagerly compiled a fresh tiny XLA program per DISTINCT
+    boundary offset — recompile churn on the checkpointed hot path
+    (every sync-mode boundary paid a compile), and a spurious hit
+    against recompile_guard(0) on warm deployments (ISSUE 8)."""
+    return jax.lax.dynamic_slice_in_dim(acc, start, n, axis=-2)
+
+
+def _slice_offset(a: int):
+    """Host int -> device scalar for _slice_draws, via device_put so
+    the chunk hot loop stays clean under transfer_guard_strict (the
+    same convention as executor.write_draws)."""
+    return jax.device_put(np.asarray(a, np.int32))
 
 
 def _clone_leaf(leaf):
@@ -339,6 +360,11 @@ def _run_identity(cfg, key, data, beta_init) -> np.ndarray:
         fault_policy="abort",
         fault_max_retries=2,
         min_surviving_frac=0.5,
+        # the AOT program store changes WHERE executables come from,
+        # never the chain (a loaded executable is the same machine
+        # code) — resuming with/without a store must be legal
+        compile_store_dir=None,
+        xla_cache_dir=None,
     )
     crcs = [zlib.crc32(repr(cfg_ident).encode())]
     crcs.append(zlib.crc32(_key_bytes(key)))
@@ -408,36 +434,58 @@ def _make_chunk_fn(model, kind, length, k, chunk_size):
     return jax.jit(chunked, donate_argnums=(1,))
 
 
-_CHUNK_PROGRAM_CACHE_MAX = 32  # buckets per model (see _cached_program)
+# L1 of the AOT program store (smk_tpu/compile/programs.py): the PR 6
+# per-model FIFO cache now lives there behind the full three-level
+# lookup. This module keeps the `_cached_program` name because the
+# chaos harness (smk_tpu/testing/faults.py) patches it to wrap chunk
+# program LOOKUPS, and every call site below routes through the module
+# global so the patch keeps intercepting.
+_CHUNK_PROGRAM_CACHE_MAX = compile_programs.L1_CACHE_MAX  # back-compat
 
 
-def _cached_program(model, key, build):
-    """Compiled chunk programs cached ON the model instance, keyed by
-    (kind, length, K, chunk_size). _make_chunk_fn builds FRESH lambdas,
-    so without this cache every fit_subsets_chunked call re-jits (and
-    XLA re-compiles) programs byte-identical to the previous call's —
-    the recompile churn ROADMAP open item 3 prices at more than the
-    fit itself on the public path. With it, two same-shape-bucket
-    calls on one model share one compile (regression-tested under
+def _cached_program(model, key, build, **kw):
+    """Program acquisition for one shape bucket — see
+    smk_tpu.compile.programs.get_program (L1 per-model FIFO → L2
+    on-disk serialized executables → fresh build, with
+    ``program_source``/``compile_s`` telemetry through ``stats``).
+    Without a ``store`` this is exactly the historical per-model
+    cache: the jitted builder output cached on the model instance,
+    compiling in its first dispatch (regression-tested under
     analysis/sanitizers.recompile_guard in tests/test_sanitizers.py).
+    """
+    return compile_programs.get_program(model, key, build, **kw)
 
-    Instance storage (not a module-level weak map) because the cached
-    jit closures hold the model strongly — a WeakKeyDictionary whose
-    values reference their key never collects; this way the
-    executables die with the model. Sound because everything a chunk
-    program closes over is frozen at model construction (SMKConfig is
-    a frozen dataclass; weight/fused_build resolve in __init__).
-    Bounded FIFO: a model driven through a sweep of buckets (varying
-    chunk_iters/K) must not accumulate multi-MB XLA executables
-    forever — a normal run touches <= 3 buckets (burn chunk, sampling
-    chunk, finalize), so evictions only happen under sweeps, where
-    re-compiling a dropped bucket is the status quo ante."""
-    per_model = model.__dict__.setdefault("_chunk_programs", {})
-    if key not in per_model:
-        while len(per_model) >= _CHUNK_PROGRAM_CACHE_MAX:
-            per_model.pop(next(iter(per_model)))
-        per_model[key] = build()
-    return per_model[key]
+
+def _chunk_key(model, kind, length, k, chunk_size, m, q, p, t, d):
+    """Bucket key of one chunk program — (kind, chunk_len, K,
+    chunk_size, m, q, p, t, d, n_chains, J, cov_model, link,
+    fused_build, config digest). kind/length lead so the chaos
+    harness keeps identifying chunk programs by key[0]/key[1]; the
+    data-derived dims (m, q, p, t, d) are explicit because the
+    config digest cannot see them."""
+    return compile_programs.chunk_bucket_key(
+        model, kind, length, k, chunk_size, m, q, p, t, d
+    )
+
+
+def _stats_key(model, k, m, q, p):
+    # the stats program's input is the carried state, whose leaf
+    # avals are determined by (k, m, q, p) + the chain axis (in the
+    # aux fields)
+    return compile_programs.aux_bucket_key(model, "stats", k, m, q, p)
+
+
+def _finalize_key(model, k, m, q, n_kept, d_par, d_w):
+    # d_par = n_params(q, p) covers p; d_w = t*q covers t
+    return compile_programs.aux_bucket_key(
+        model, "finalize", k, m, q, n_kept, d_par, d_w
+    )
+
+
+def _refork_key(model, k, m, q, p):
+    # state-shaped like the stats program: the relaunch must miss
+    # (never mis-load) across datasets with different subset shapes
+    return compile_programs.aux_bucket_key(model, "refork", k, m, q, p)
 
 
 def _read_segments(path, seg_base, n_segments, filled, dtype):
@@ -988,19 +1036,20 @@ def fit_subsets_chunked(
             jnp.zeros(lead + (n_kept, d_w), dtype),
         )
 
-    def to_capacity(draws):
+    def to_capacity(draws_np):
         """Pad a checkpointed accumulator up to full capacity —
         save() serializes only the filled draws region (exactly the
         iterations recorded at save time), so every load re-creates
-        the zero tail. (Pre-change grown-concat checkpoints share
-        this on-disk layout, but the run-identity stamp — which
-        hashes the config repr, now including fused_build — already
-        rejects cross-build resumes before shapes matter.)"""
-        short = n_kept - draws.shape[-2]
-        if short == 0:
-            return draws
-        pad = [(0, 0)] * (draws.ndim - 2) + [(0, short), (0, 0)]
-        return jnp.pad(draws, pad)
+        the zero tail. The pad runs in NUMPY on the loaded host
+        arrays: an eager device pad compiles a fresh tiny program per
+        distinct filled length, which would make every resume point a
+        recompile_guard hit (ISSUE 8 — resumes on a warm store are
+        compile-free, regression-tested in test_compile_store.py)."""
+        short = n_kept - draws_np.shape[-2]
+        if short != 0:
+            pad = [(0, 0)] * (draws_np.ndim - 2) + [(0, short), (0, 0)]
+            draws_np = np.pad(draws_np, pad)
+        return jnp.asarray(draws_np, dtype)
 
     meta = np.asarray(
         [cfg.n_samples, cfg.n_burn_in, k, d_par, d_w, cfg.n_chains],
@@ -1122,8 +1171,8 @@ def fit_subsets_chunked(
             )
             holes = []
         if filled > 0:
-            param_draws = to_capacity(jnp.asarray(param_np, dtype))
-            w_draws = to_capacity(jnp.asarray(w_np, dtype))
+            param_draws = to_capacity(param_np)
+            w_draws = to_capacity(w_np)
         else:
             param_draws, w_draws = empty_draws()
         ck.adopt(seg_base, n_seg, filled)
@@ -1148,16 +1197,53 @@ def fit_subsets_chunked(
         it = 0
         holes = []
 
+    # L2 program store (ISSUE 8): consulted BEFORE tracing — a store
+    # hit deserializes the executable and the chunk program never
+    # compiles in this process. Disabled under an explicit mesh
+    # (serialized executables bake in their device assignment).
+    store = compile_programs.store_from_config(cfg, mesh)
+    # lowering arguments for the AOT path: the chunk programs are
+    # lowered against the live data, the init-state avals, and the
+    # exact weak-int32 scalar aval dispatch() feeds at runtime
+    chunk_lower = (
+        (data, init_like, jax.device_put(0))
+        if store is not None
+        else None
+    )
+
+    t_test = coords_test.shape[0]
+    d_coord = coords_test.shape[1]
+
     def chunk_fn(kind: str, n: int):
         return _cached_program(
-            model, (kind, n, k, chunk_size),
+            model,
+            _chunk_key(
+                model, kind, n, k, chunk_size, m, q, p, t_test,
+                d_coord,
+            ),
             lambda: _make_chunk_fn(model, kind, n, k, chunk_size),
+            store=store, lower_args=chunk_lower, stats=pstats,
         )
 
     n_burn = cfg.n_burn_in
     # quarantine needs the per-subset guard vector at every boundary
     # whether or not the caller asked for nan_guard/progress
     want_stats = nan_guard or progress is not None or policy_q
+    # the boundary guard/report program, through the same store
+    # (resolving it here, not per boundary, keeps the hot loop to a
+    # dict hit; with the store off this IS the module-level
+    # _chunk_stats jit, byte-identically)
+    stats_fn = (
+        _cached_program(
+            model, _stats_key(model, k, m, q, p),
+            lambda: _chunk_stats,
+            store=store,
+            lower_args=(init_like,) if store is not None else None,
+            stats=pstats,
+        )
+        if want_stats
+        else None
+    )
     warned_progress = [False]
 
     def call_progress(info):
@@ -1243,8 +1329,23 @@ def fit_subsets_chunked(
     t_loop0 = time.perf_counter()
     refork = (
         _cached_program(
-            model, ("refork", k),
+            model, _refork_key(model, k, m, q, p),
             lambda: _make_refork(cfg.n_chains),
+            store=store,
+            # the quarantine relaunch must reuse the stored program:
+            # a disk-warm model's FIRST fault would otherwise compile
+            # the refork on the retry critical path
+            # (tests/test_compile_store.py pins zero compiles there)
+            lower_args=(
+                (
+                    init_like,
+                    jax.ShapeDtypeStruct((k,), np.bool_),
+                    jax.ShapeDtypeStruct((k,), np.int32),
+                )
+                if store is not None
+                else None
+            ),
+            stats=pstats,
         )
         if policy_q
         else None
@@ -1417,7 +1518,7 @@ def fit_subsets_chunked(
         nonlocal state
         it_end = start + n
         phase = {"burn": "burn", "fill": "fill"}.get(kind, "sample")
-        stats = _chunk_stats(state) if want_stats else None
+        stats = stats_fn(state) if want_stats else None
         if stats is not None and mode == "overlap":
             for leaf in stats:
                 # smklint: disable=SMK104 -- stats are fresh outputs of the _chunk_stats jit (never donated); getattr probes for numpy leaves on resume paths
@@ -1445,8 +1546,9 @@ def fit_subsets_chunked(
                 d2h += tree_nbytes(state)
             if kind == "samp":
                 a, b_ = start - n_burn, filled
-                sl_p = param_draws[..., a:b_, :]
-                sl_w = w_draws[..., a:b_, :]
+                ofs = _slice_offset(a)
+                sl_p = _slice_draws(param_draws, ofs, b_ - a)
+                sl_w = _slice_draws(w_draws, ofs, b_ - a)
                 if mode == "overlap":
                     draws = HostSnapshot((sl_p, sl_w))
                     d2h += draws.nbytes
@@ -1556,8 +1658,15 @@ def fit_subsets_chunked(
         return None
 
     finalize = _cached_program(
-        model, ("finalize",),
+        model, _finalize_key(model, k, m, q, n_kept, d_par, d_w),
         lambda: jax.jit(jax.vmap(model.finalize)),
+        store=store,
+        lower_args=(
+            (init_like, param_draws, w_draws)
+            if store is not None
+            else None
+        ),
+        stats=pstats,
     )
     return finalize(state, param_draws, w_draws)
 

@@ -110,8 +110,11 @@ def _ensure_nan_patched() -> None:
             return
         real = _recovery._cached_program
 
-        def looking_up(model, key, build):
-            fn = real(model, key, build)
+        def looking_up(model, key, build, **kw):
+            # pass the store/telemetry kwargs through untouched: the
+            # injector wraps the LOOKUP result (jit or deserialized
+            # L2 executable alike), never what the levels cache
+            fn = real(model, key, build, **kw)
             # wrap ONLY chunk programs, ONLY while armed, and ONLY at
             # lookup time — the model's cache holds the clean
             # executable, so warm models inject and disarmed runs are

@@ -122,6 +122,7 @@ class ChunkPipelineStats:
     fault_policy: str = "abort"
     chunks: List[Dict[str, Any]] = field(default_factory=list)
     fault_events: List[Dict[str, Any]] = field(default_factory=list)
+    programs: List[Dict[str, Any]] = field(default_factory=list)
     ckpt_write_s: float = 0.0
     ckpt_bytes: int = 0
     ckpt_boundary_bytes: List[int] = field(default_factory=list)
@@ -163,6 +164,44 @@ class ChunkPipelineStats:
             "attempts": {int(j): int(n) for j, n in attempts.items()},
         })
 
+    def record_program(
+        self, *, key, source: str, compile_s: float = 0.0,
+        aot: bool = False,
+    ) -> None:
+        """One compiled-program acquisition (ISSUE 8,
+        smk_tpu/compile/programs.get_program): the shape-bucket
+        ``key``, where the executable came from (``source`` in
+        {"l1", "l2", "l3", "fresh"} — in-memory hit, deserialized
+        from the on-disk store, fresh trace with the persistent XLA
+        cache armed, fresh trace with no cache), and the seconds the
+        acquisition cost on the host (AOT lower+compile or L2
+        deserialize; 0.0 for lazy jit builds, whose compile lands
+        inside their first dispatch). The first record per key wins —
+        the executor re-resolves programs every dispatch, and only
+        the acquisition is provenance."""
+        key_l = [str(f) for f in key]
+        if any(p["key"] == key_l for p in self.programs):
+            return
+        self.programs.append({
+            "key": key_l,
+            "source": source,
+            "compile_s": round(float(compile_s), 4),
+            "aot": bool(aot),
+        })
+
+    def program_summary(self) -> Dict[str, Any]:
+        """Compile telemetry compressed for a bench record: total
+        acquisition seconds plus a source histogram."""
+        sources: Dict[str, int] = {}
+        for p in self.programs:
+            sources[p["source"]] = sources.get(p["source"], 0) + 1
+        return {
+            "compile_s": round(
+                sum(p["compile_s"] for p in self.programs), 4
+            ),
+            "program_sources": sources,
+        }
+
     def add_ckpt_write(self, seconds: float, nbytes: int) -> None:
         with self._lock:
             self.ckpt_write_s += float(seconds)
@@ -200,6 +239,11 @@ class ChunkPipelineStats:
             # JSON-friendly (string subset ids) for bench/protocol
             # records
             "fault": self.fault_summary(),
+            # ISSUE 8 compile telemetry: where every hot program came
+            # from (L1/L2/L3/fresh) and what acquisition cost —
+            # program_sources all-"l2" with compile_s ~0 is the
+            # warm-deployment signature ROADMAP item 3 targets
+            **self.program_summary(),
         }
 
     def fault_summary(self) -> Dict[str, Any]:

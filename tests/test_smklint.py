@@ -541,6 +541,77 @@ class TestFaultInjectionZone:
         assert "SMK108" in rules_hit(broken, path=real)
 
 
+class TestCompileCacheConfig:
+    """SMK109 (ISSUE 8): the persistent XLA compile cache is armed
+    through smk_tpu/compile/xla_cache.py only."""
+
+    DIRECT_FORMS = [
+        'import jax\njax.config.update('
+        '"jax_compilation_cache_dir", "/tmp/c")\n',
+        'import jax\njax.config.update('
+        '"jax_persistent_cache_min_compile_time_secs", 1.0)\n',
+        'from jax import config\nconfig.update('
+        '"jax_compilation_cache_dir", "/tmp/c")\n',
+        # keyword spelling of the same call
+        'import jax\njax.config.update('
+        'name="jax_persistent_cache_min_entry_size_bytes", val=0)\n',
+    ]
+
+    @pytest.mark.parametrize("src", DIRECT_FORMS)
+    def test_direct_update_flagged_everywhere_outside_helper(self, src):
+        assert "SMK109" in rules_hit(src, path=MODELS_PATH)
+        assert "SMK109" in rules_hit(src, path=SCRIPT_PATH)
+        assert "SMK109" in rules_hit(src, path="bench.py")
+        assert "SMK109" in rules_hit(src, path=TESTS_PATH)
+
+    @pytest.mark.parametrize("src", DIRECT_FORMS[:2])
+    def test_helper_module_is_the_sanctioned_writer(self, src):
+        assert "SMK109" not in rules_hit(
+            src, path="smk_tpu/compile/xla_cache.py"
+        )
+        assert "SMK109" not in rules_hit(
+            src, path="smk_tpu/compile/fixture.py"
+        )
+
+    def test_other_config_updates_not_flagged(self):
+        src = (
+            'import jax\n'
+            'jax.config.update("jax_platforms", "cpu")\n'
+            'jax.config.update("jax_enable_x64", False)\n'
+            'd = {}\nd.update(jax_compilation="x")\n'
+        )
+        assert "SMK109" not in rules_hit(src, path=MODELS_PATH)
+
+    def test_cache_key_string_outside_update_call_not_flagged(self):
+        # naming the key (docs, messages, comparisons) is fine — only
+        # a direct *.update(...) of it bypasses the helper
+        src = 'KEY = "jax_compilation_cache_dir"\nprint(KEY)\n'
+        assert "SMK109" not in rules_hit(src, path=MODELS_PATH)
+
+    def test_justified_suppression_respected(self):
+        src = (
+            "# smklint: disable=SMK109 -- fixture exercising the rule\n"
+            'jax.config.update("jax_compilation_cache_dir", "/t")\n'
+        )
+        assert "SMK109" not in rules_hit(src, path=MODELS_PATH)
+
+    def test_real_bench_clean_and_seeded_defect_caught(self):
+        """bench.py now routes through the shared helper (ISSUE 8
+        satellite 1); pasting the old private block back in must be
+        caught."""
+        src = repo_file("bench.py")
+        assert "SMK109" not in rules_hit(src, path="bench.py")
+        broken = src + (
+            '\njax.config.update('
+            '"jax_compilation_cache_dir", "/tmp/private")\n'
+        )
+        assert "SMK109" in rules_hit(broken, path="bench.py")
+
+    def test_real_helper_clean(self):
+        real = "smk_tpu/compile/xla_cache.py"
+        assert "SMK109" not in rules_hit(repo_file(real), path=real)
+
+
 class TestTreeGate:
     def test_repo_lints_clean(self):
         """The acceptance gate as a tier-1 test: zero unsuppressed
