@@ -67,6 +67,14 @@ lower().compile() and serialized there, a warm directory serves them
 back with zero backend compiles, and the rung record stamps
 program_sources + the measured acquisition seconds
 (pipeline.compile_s). Draws are bit-identical with the store on/off.
+BENCH_LIVE_DIAG=0 disables the streaming convergence monitor the
+public chunked rungs run with by default (ISSUE 10, smk_tpu/obs/ —
+per-boundary on-device split-R-hat/ESS; bit-identical draws, two
+(K,) vectors of extra D2H per boundary); each chunked rung stamps
+live_rhat_final / live_ess_min_final / hbm_peak_bytes.
+BENCH_RUN_LOG=<dir> arms the structured JSONL run log on every rung
+(the record stamps run_log with the file path; summarize with
+`python -m smk_tpu.obs summarize <path>`). Default off.
 
 Synthetic latent surfaces use random Fourier features (an O(n)
 stationary GP approximation) so data generation never needs an n x n
@@ -74,6 +82,7 @@ factorization.
 """
 
 import json
+import math
 import os
 import signal
 import sys
@@ -480,6 +489,12 @@ def rung_config(env, *, k, n_samples, cov_model, link, n_chains=1,
         # program_sources={"l2": ...} (draws bit-identical either
         # way; empty/unset = off, the historical in-dispatch compile)
         compile_store_dir=env.get("BENCH_COMPILE_STORE") or None,
+        # unified run telemetry (ISSUE 10): live streaming R-hat/ESS
+        # on by default (pure observability — draws bit-identical,
+        # the rung record gains live_rhat_final); run log opt-in via
+        # BENCH_RUN_LOG=<dir>
+        live_diagnostics=env.get("BENCH_LIVE_DIAG", "1") != "0",
+        run_log_dir=env.get("BENCH_RUN_LOG") or None,
         chol_block_size=int(env.get("BENCH_CHOL_BLOCK", 0)),
         # blocked-GEMM trisolves with carried panel inverses: XLA's
         # native trisolve is latency-bound at these shapes (measured
@@ -760,6 +775,17 @@ def run_rung_public(name, *, n, k, cov_model, n_samples, q=1, p=2,
 
     fit_s, compile_est = exec_split()
     fault = pstats.fault_summary()
+
+    # ISSUE 10 telemetry, aggregated ONCE and NaN-sanitized up front
+    # (a NaN live metric — too few boundaries for the estimator —
+    # must not put a bare NaN token anywhere in the JSON protocol
+    # stream, including inside the nested pipeline block)
+    agg = pstats.aggregate()
+    for live_key in ("live_rhat_final", "live_ess_min_final"):
+        v = agg[live_key]
+        agg[live_key] = (
+            v if v is not None and math.isfinite(v) else None
+        )
     record = {
         "rung": name,
         "n": n, "K": k, "m": m, "q": q, "cov_model": cov_model,
@@ -783,7 +809,7 @@ def run_rung_public(name, *, n, k, cov_model, n_samples, q=1, p=2,
         # chunk queued
         "chunk_pipeline": cfg.chunk_pipeline,
         "pipeline": {
-            k_: v for k_, v in pstats.aggregate().items()
+            k_: v for k_, v in agg.items()
             if k_ != "ckpt_boundary_bytes"
         },
         # ISSUE 7: the fault-isolation policy this rung ran under,
@@ -802,6 +828,17 @@ def run_rung_public(name, *, n, k, cov_model, n_samples, q=1, p=2,
         "compile_store": cfg.compile_store_dir,
         "program_sources": pstats.program_summary()["program_sources"],
     }
+    # ISSUE 10: the final-boundary streaming diagnostics (None when
+    # BENCH_LIVE_DIAG=0), the boundary-sampled HBM high-water mark
+    # (None on statless backends), and the run-log path (None unless
+    # BENCH_RUN_LOG) — surfaced top-level next to the analytic bytes
+    # model so rung health is visible without re-running
+    record["live_rhat_final"] = agg["live_rhat_final"]
+    record["live_ess_min_final"] = agg["live_ess_min_final"]
+    record["hbm_peak_bytes"] = agg["hbm_peak_bytes"]
+    record["run_log"] = (
+        pstats.run_log.path if pstats.run_log is not None else None
+    )
     return rung_diagnostics(
         record, res, cfg, m=m, k=k, q=q, p_dim=p, n_samples=n_samples,
         n_test=n_test, fit_s=fit_s, coords0=part.coords[0],

@@ -67,6 +67,7 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
                                 n.report = NULL,
                                 checkpoint.path = NULL,
                                 compile.store.dir = NULL,
+                                run.log.dir = NULL,
                                 backend = c("tpu", "cpu"),
                                 seed = 0L,
                                 python_path = NULL,
@@ -137,6 +138,14 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
   # stale (different jax/device) or corrupt artifact is rebuilt with
   # a warning, never mis-loaded. Implies the chunked executor (see
   # the README's "AOT & compile caching" section).
+  # run.log.dir: directory for the structured per-fit run log
+  # (ISSUE 10, smk_tpu/obs/). When set, every fit appends one JSONL
+  # timeline file there — phases as nested spans, every chunk/fault/
+  # compile/checkpoint as an event — and the file path is returned
+  # as $run.log.path; summarize it with
+  #   python -m smk_tpu.obs summarize <path>
+  # Pure observability: the draws are bit-identical with the log on
+  # or off (see the README's "Observability" section).
   k.prior <- match.arg(k.prior)
   phi.proposal.family <- match.arg(phi.proposal.family)
   fused.build <- match.arg(fused.build)
@@ -194,6 +203,7 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
     fault_policy = fault.policy,
     fault_max_retries = as.integer(fault.max.retries),
     compile_store_dir = compile.store.dir,
+    run_log_dir = run.log.dir,
     priors = smk$PriorConfig(a_prior = k.prior)
   ), config.overrides)
   cfg <- do.call(smk$SMKConfig, cfg_args)
@@ -242,6 +252,8 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
     # 0-based subset indices dropped under fault.policy =
     # "quarantine" (empty integer vector on a healthy run)
     subsets.dropped = as.integer(unlist(res$subsets_dropped)),
+    # path of the structured run log (NULL unless run.log.dir was set)
+    run.log.path = res$run_log_path,
     param.names = unlist(smk$api$param_names(as.integer(q), as.integer(p)))
   )
 }

@@ -252,9 +252,9 @@ def main(out_path: str) -> int:
             "stale-fingerprint artifacts rebuilt, never mis-loaded",
         ],
     })
-    with open(out_path, "w") as f:
-        for r in records:
-            f.write(json.dumps(r) + "\n")
+    from smk_tpu.obs.reporter import write_records
+
+    write_records(out_path, records)
     for r in records:
         print(json.dumps(r))
     return 0 if ok else 1

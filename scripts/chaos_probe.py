@@ -42,7 +42,6 @@ Usage: JAX_PLATFORMS=cpu python scripts/chaos_probe.py [out.jsonl]
 
 import dataclasses
 import hashlib
-import json
 import os
 import sys
 import tempfile
@@ -57,6 +56,7 @@ import numpy as np
 jax.config.update("jax_platforms", "cpu")
 
 from smk_tpu.analysis.sanitizers import recompile_guard
+from smk_tpu.obs.reporter import write_records
 from smk_tpu.config import SMKConfig
 from smk_tpu.models.probit_gp import SpatialProbitGP
 from smk_tpu.parallel.combine import (
@@ -364,9 +364,7 @@ def main(out_path="FAULTS_r09.jsonl"):
         "record": "abort_policy_guard_parity", **abort_leg,
     })
 
-    with open(out_path, "w") as f:
-        for r in records:
-            f.write(json.dumps(r) + "\n")
+    write_records(out_path, records)
 
     def bools(o):
         """Every boolean leaf in the record tree — EVERY protocol

@@ -7,6 +7,12 @@ Shares its data/config/program build with xla_cost_check.py via
 _slice_harness so the two committed artifacts describe the same
 program.
 
+Trace capture/parsing goes through smk_tpu.obs.profiling (ISSUE 10
+pillar 4) — the Chrome-trace loading, device-pid discovery and
+per-op aggregation that used to be hand-rolled here are the shared
+helpers every profile consumer now uses; this script keeps only the
+program build and the loop-census attribution model.
+
 Attribution model: the trace is hierarchical. The op names are
 structural (`while.N`, `conditional.N`, `fusion.N`), and for THIS
 program's lowering exactly two While ops exist — the outer Gibbs scan
@@ -21,8 +27,6 @@ Run on TPU:  python scripts/profile_trace.py
 Commit the output (TRACE_SUMMARY_r03.json).
 """
 
-import glob
-import gzip
 import json
 import os
 import re
@@ -40,6 +44,12 @@ from scripts._slice_harness import (
     build_chunk_program,
     make_slice_data,
     real_init_states,
+)
+from smk_tpu.obs.profiling import (
+    device_op_totals,
+    latest_chrome_trace,
+    load_trace_events,
+    scope_totals,
 )
 from smk_tpu.utils.tracing import device_sync
 
@@ -68,41 +78,15 @@ def main():
     jax.profiler.stop_trace()
     wall_s = time.time() - t0
 
-    paths = sorted(
-        glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
-                  recursive=True)
-    )
-    if not paths:
+    trace_path = latest_chrome_trace(trace_dir)
+    if trace_path is None:
         sys.exit(
             f"profiler produced no *.trace.json.gz under {trace_dir} — "
             "the trace capture failed (tunnel drop or profiler not "
             "supported on this backend); re-run"
         )
-    with gzip.open(paths[-1], "rt") as f:
-        trace = json.load(f)
-    events = trace["traceEvents"]
-
-    # device pids: process_name metadata mentioning TPU/device
-    pid_names = {
-        e["pid"]: e["args"]["name"]
-        for e in events
-        if e.get("ph") == "M" and e.get("name") == "process_name"
-        and "args" in e
-    }
-    dev_pids = {
-        p for p, n in pid_names.items()
-        if re.search(r"TPU|device|/stream", n, re.I)
-        and not re.search(r"host|python", n, re.I)
-    }
-
-    by_name = {}
-    for e in events:
-        if e.get("ph") != "X" or e.get("pid") not in dev_pids:
-            continue
-        dur = float(e.get("dur", 0.0))
-        if dur <= 0:
-            continue
-        by_name[e["name"]] = by_name.get(e["name"], 0.0) + dur
+    events = load_trace_events(trace_path)
+    by_name = device_op_totals(events)
 
     whiles = sorted(
         ((n, us) for n, us in by_name.items()
@@ -123,6 +107,12 @@ def main():
         "device": str(jax.devices()[0]),
         "m": M, "K": K, "q": Q, "chunk": CHUNK,
         "wall_s": round(wall_s, 2),
+        # named-scope attribution (MTM_CHOL_SCOPE / FUSED_BUILD_SCOPE
+        # — the repo's two instrumented kernel scopes)
+        "scope_ms_per_iter": {
+            k: round(us / 1e3 / CHUNK, 3)
+            for k, us in scope_totals(events).items()
+        },
         "while_ops_ms_per_iter": [
             {"op": n, "ms": round(us / 1e3 / CHUNK, 2)}
             for n, us in whiles

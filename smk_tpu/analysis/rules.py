@@ -1165,6 +1165,118 @@ class CompileCacheConfigRule(Rule):
                     break
 
 
+# ---------------------------------------------------------------------------
+# SMK110 — telemetry discipline (one span source of truth)
+# ---------------------------------------------------------------------------
+
+# The sanctioned telemetry zones: the obs subsystem itself and the
+# tracing module that owns the clock (utils/tracing.monotonic) and
+# the span/stats primitives.
+_TELEMETRY_ZONES = ("smk_tpu/obs/", "smk_tpu/utils/tracing")
+
+# time-module members whose CALL in library code is ad-hoc telemetry
+# (interval timing / timestamping). time.sleep, strftime, gmtime etc.
+# are not timing instrumentation and stay legal.
+_TIME_CLOCK_FNS = {
+    "time", "time_ns", "perf_counter", "perf_counter_ns",
+    "monotonic", "monotonic_ns", "process_time", "process_time_ns",
+}
+
+
+class TelemetryDisciplineRule(Rule):
+    id = "SMK110"
+    name = "telemetry-discipline"
+    doc = (
+        "smk_tpu/ library code outside smk_tpu/obs/ and "
+        "utils/tracing.py may not take its own wall-clock "
+        "measurements (time.perf_counter()/time.time()/...) or emit "
+        "its own JSONL lines (f.write(json.dumps(...))) — "
+        "utils/tracing.monotonic is the one clock, "
+        "phase_timer/ChunkPipelineStats/the run log are the one span "
+        "source of truth, and obs/reporter.py is the one JSONL "
+        "writer (ISSUE 10: five ad-hoc telemetry surfaces grew "
+        "before one run-level view existed)"
+    )
+
+    def applies(self, module):
+        norm = module.norm_path()
+        if any(z in norm for z in _TELEMETRY_ZONES):
+            return False
+        return "smk_tpu/" in norm
+
+    def check(self, module, ctx):
+        # names imported straight off the time module:
+        # `from time import perf_counter` / `... as clock`
+        time_member_aliases: dict = {}
+        time_module_aliases: Set[str] = set()
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "time":
+                        time_module_aliases.add(a.asname or "time")
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "time" and node.level == 0:
+                    for a in node.names:
+                        if a.name in _TIME_CLOCK_FNS:
+                            time_member_aliases[
+                                a.asname or a.name
+                            ] = a.name
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            if (
+                len(chain) == 2
+                and chain[0] in time_module_aliases
+                and chain[1] in _TIME_CLOCK_FNS
+            ):
+                yield self.finding(
+                    module, node,
+                    f"direct {chain[0]}.{chain[1]}() timing in "
+                    "library code — take timestamps from "
+                    "utils/tracing.monotonic (or emit through "
+                    "phase_timer / ChunkPipelineStats / the run "
+                    "log) so every measurement lands in the one "
+                    "span source of truth",
+                )
+            elif (
+                len(chain) == 1 and chain[0] in time_member_aliases
+            ):
+                orig = time_member_aliases[chain[0]]
+                yield self.finding(
+                    module, node,
+                    f"direct time.{orig}() timing (imported as "
+                    f"{chain[0]}) in library code — use "
+                    "utils/tracing.monotonic / phase_timer instead",
+                )
+            # JSONL emission: a .write(...) whose argument embeds
+            # json.dumps(...) — the hand-rolled line-record writer
+            # obs/reporter.py replaces. json.dumps alone (manifests,
+            # fingerprints) stays legal.
+            if (
+                chain
+                and chain[-1] == "write"
+                and isinstance(node.func, ast.Attribute)
+            ):
+                for arg in node.args:
+                    hit = any(
+                        isinstance(sub, ast.Call)
+                        and attr_chain(sub.func)[-1:] == ("dumps",)
+                        for sub in ast.walk(arg)
+                    )
+                    if hit:
+                        yield self.finding(
+                            module, node,
+                            "hand-rolled JSONL emission "
+                            "(.write(json.dumps(...))) in library "
+                            "code — write line records through "
+                            "smk_tpu.obs.reporter (JsonlWriter / "
+                            "write_records): flush-per-record and "
+                            "crash-truncation safety live there",
+                        )
+                        break
+
+
 ALL_RULES = [
     BatchingRuleRule(),
     HostNondeterminismRule(),
@@ -1175,4 +1287,5 @@ ALL_RULES = [
     UnusedImportRule(),
     FaultInjectionZoneRule(),
     CompileCacheConfigRule(),
+    TelemetryDisciplineRule(),
 ]

@@ -68,6 +68,10 @@ class MetaKrigingResult(NamedTuple):
         grids non-finite — parallel/recovery.py). Empty on fault-free
         runs and always empty under the default ``"abort"`` policy,
         which raises instead of degrading.
+    run_log_path : path of this fit's structured JSONL run log when
+        ``config.run_log_dir`` is set (ISSUE 10, smk_tpu/obs/ —
+        summarize with ``python -m smk_tpu.obs summarize``); None
+        when the run log is off.
     """
 
     param_grid: jnp.ndarray
@@ -87,6 +91,7 @@ class MetaKrigingResult(NamedTuple):
     latent_ess_per_sec: float
     phase_seconds: dict
     subsets_dropped: tuple = ()
+    run_log_path: Optional[str] = None
 
 
 def param_names(q: int, p: int) -> list[str]:
@@ -226,8 +231,109 @@ def fit_meta_kriging(
     latter (L3) arms jax's persistent XLA compilation cache. Draws
     are bit-identical with the store on or off (a loaded executable
     is the same machine code the building process ran).
+
+    ``config.run_log_dir`` / ``config.live_diagnostics`` /
+    ``config.profile_dir`` arm the unified telemetry subsystem
+    (ISSUE 10, smk_tpu/obs/): one structured JSONL run log per fit
+    (every phase a span, every chunk/fault/program/checkpoint an
+    event — ``python -m smk_tpu.obs summarize`` reconstructs the
+    timeline; the path is returned as ``result.run_log_path``),
+    on-device streaming split-R-hat/ESS at chunk boundaries
+    (``live_rhat_max``/``live_ess_min`` in the progress dict — raise
+    a ProgressAbort subclass to kill a sick run early; implies
+    chunked execution), and jax.profiler capture over a chunk
+    window. All of it is observational: draws are bit-identical
+    armed vs off.
     """
     cfg = config or SMKConfig()
+    run_log = None
+    # truthiness, not `is not None`: an empty-string run_log_dir must
+    # mean "off" here exactly as it does in the executor wrapper —
+    # never an os.makedirs("") crash in one entry point and a no-op
+    # in the other
+    if cfg.run_log_dir:
+        from smk_tpu.obs.events import open_run_log
+
+        run_log = open_run_log(
+            cfg.run_log_dir,
+            name="fit_meta_kriging",
+            meta={
+                "n": int(y.shape[0]) if hasattr(y, "shape") else None,
+                "n_subsets": cfg.n_subsets,
+                "n_samples": cfg.n_samples,
+                "cov_model": cfg.cov_model,
+                "link": cfg.link,
+            },
+        )
+    if run_log is None and not cfg.live_diagnostics:
+        return _fit_meta_kriging_impl(
+            key, y, x, coords, coords_test, x_test, config=cfg,
+            weight=weight, sharded=sharded, mesh=mesh,
+            chunk_size=chunk_size, chunk_iters=chunk_iters,
+            checkpoint_path=checkpoint_path,
+            checkpoint_every=checkpoint_every, progress=progress,
+            nan_guard=nan_guard, pipeline_stats=pipeline_stats,
+            run_log=None,
+        )
+    # an internal stats sink when obs is armed and the caller brought
+    # none: chunk/fault/program events flow into the run log through
+    # it, and the aggregate (live_rhat_final, hbm_peak_bytes) stays
+    # reachable for the log's closing record
+    pstats = pipeline_stats
+    if pstats is None:
+        from smk_tpu.utils.tracing import ChunkPipelineStats
+
+        pstats = ChunkPipelineStats()
+    if run_log is not None:
+        pstats.run_log = run_log
+        try:
+            with run_log.span("fit_meta_kriging"):
+                return _fit_meta_kriging_impl(
+                    key, y, x, coords, coords_test, x_test,
+                    config=cfg, weight=weight, sharded=sharded,
+                    mesh=mesh, chunk_size=chunk_size,
+                    chunk_iters=chunk_iters,
+                    checkpoint_path=checkpoint_path,
+                    checkpoint_every=checkpoint_every,
+                    progress=progress, nan_guard=nan_guard,
+                    pipeline_stats=pstats, run_log=run_log,
+                )
+        finally:
+            run_log.close(pipeline=pstats.aggregate())
+    return _fit_meta_kriging_impl(
+        key, y, x, coords, coords_test, x_test, config=cfg,
+        weight=weight, sharded=sharded, mesh=mesh,
+        chunk_size=chunk_size, chunk_iters=chunk_iters,
+        checkpoint_path=checkpoint_path,
+        checkpoint_every=checkpoint_every, progress=progress,
+        nan_guard=nan_guard, pipeline_stats=pstats, run_log=None,
+    )
+
+
+def _fit_meta_kriging_impl(
+    key: jax.Array,
+    y: jnp.ndarray,
+    x: jnp.ndarray,
+    coords: jnp.ndarray,
+    coords_test: jnp.ndarray,
+    x_test: jnp.ndarray,
+    *,
+    config: SMKConfig,
+    weight: int = 1,
+    sharded: bool = False,
+    mesh=None,
+    chunk_size: Optional[int] = None,
+    chunk_iters: Optional[int] = None,
+    checkpoint_path: Optional[str] = None,
+    checkpoint_every: int = 500,
+    progress=None,
+    nan_guard: bool = False,
+    pipeline_stats=None,
+    run_log=None,
+) -> MetaKrigingResult:
+    """The pipeline body behind :func:`fit_meta_kriging` (which owns
+    the run-log lifecycle — see its docstring)."""
+    cfg = config
     times = PhaseTimes()
     # L3 of the AOT program store (ISSUE 8): arm jax's persistent XLA
     # compilation cache when the config names a directory — the same
@@ -290,11 +396,11 @@ def fit_meta_kriging(
             f"p={x.shape[2]}) designs, got shape {x_test.shape}"
         )
 
-    with phase_timer(times, "partition"):
+    with phase_timer(times, "partition", log=run_log):
         part = random_partition(k_part, y, x, coords, cfg.n_subsets)
         device_sync(part.y)
 
-    with phase_timer(times, "warm_start"):
+    with phase_timer(times, "warm_start", log=run_log):
         y_long, x_long = stacked_design(y, x)
         fit = glm_warm_start(y_long, x_long, weight=weight, link=cfg.link)
         q, p = x.shape[1], x.shape[2]
@@ -302,7 +408,7 @@ def fit_meta_kriging(
         device_sync(beta_init)
 
     model = SpatialGPSampler(cfg, weight=weight)
-    with phase_timer(times, "subset_fits"):
+    with phase_timer(times, "subset_fits", log=run_log):
         if (
             checkpoint_path is not None
             or chunk_iters is not None
@@ -312,6 +418,9 @@ def fit_meta_kriging(
             # guard — the policy implies chunked execution just as
             # nan_guard does
             or cfg.fault_policy == "quarantine"
+            # the streaming convergence monitor (ISSUE 10) lives at
+            # the chunk boundary — arming it implies chunking too
+            or cfg.live_diagnostics
             # the L2 program store's shape-bucketed programs live in
             # the chunked executor, which consults the store before
             # tracing (ISSUE 8) — enabling it implies chunking too
@@ -365,7 +474,7 @@ def fit_meta_kriging(
         survival_mask[failed] = False
         subsets_dropped = tuple(int(i) for i in failed)
 
-    with phase_timer(times, "combine"):
+    with phase_timer(times, "combine", log=run_log):
         param_grid = combine_quantile_grids(
             results.param_grid, cfg.combiner,
             n_iter=cfg.weiszfeld_iters, eps=cfg.weiszfeld_eps,
@@ -380,7 +489,7 @@ def fit_meta_kriging(
         )
         device_sync((param_grid, w_grid))
 
-    with phase_timer(times, "resample_predict"):
+    with phase_timer(times, "resample_predict", log=run_log):
         dense_par = interp_quantile_grid(param_grid, cfg.interp_grid_step)
         dense_w = interp_quantile_grid(w_grid, cfg.interp_grid_step)
         sample_par, sample_w = inverse_cdf_resample(
@@ -422,4 +531,5 @@ def fit_meta_kriging(
         ),
         phase_seconds=times.as_dict(),
         subsets_dropped=subsets_dropped,
+        run_log_path=run_log.path if run_log is not None else None,
     )

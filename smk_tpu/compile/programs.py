@@ -40,11 +40,11 @@ import dataclasses
 import functools
 import hashlib
 import os
-import time
 from typing import Optional
 
 from smk_tpu.compile.store import ProgramStore
 from smk_tpu.compile.xla_cache import persistent_cache_enabled
+from smk_tpu.utils.tracing import monotonic
 
 # FIFO bound of the per-model L1 cache: a model driven through a sweep
 # of buckets (varying chunk_iters/K) must not accumulate multi-MB XLA
@@ -65,6 +65,13 @@ _DIGEST_NEUTRAL = dict(
     min_surviving_frac=0.5,
     compile_store_dir=None,
     xla_cache_dir=None,
+    # observability knobs (ISSUE 10) watch the chain without changing
+    # any compiled program — an obs-armed run must resolve the SAME
+    # bucket keys (and serve/fill the same store) as an unarmed one
+    run_log_dir=None,
+    live_diagnostics=False,
+    profile_dir=None,
+    profile_chunks=None,
 )
 
 
@@ -200,7 +207,7 @@ def get_program(
         per_model[key] = fn
         return fn
 
-    t0 = time.perf_counter()
+    t0 = monotonic()
     if lower_args is not None:
         # AOT path: with a store, consult it first; with or without
         # one, the program is built by lower().compile() — off the
@@ -210,11 +217,11 @@ def get_program(
         if compiled is not None:
             mark_persisted()
             _record(
-                stats, key, "l2", time.perf_counter() - t0, True
+                stats, key, "l2", monotonic() - t0, True
             )
             return insert(compiled)
         compiled = build().lower(*lower_args).compile()
-        compile_s = time.perf_counter() - t0
+        compile_s = monotonic() - t0
         if store is not None:
             store.save(key, compiled)
             mark_persisted()

@@ -21,10 +21,10 @@ it never holds data for.
 
 from __future__ import annotations
 
-import time
 from typing import Any, Dict, List, Optional
 
 from smk_tpu.compile.programs import get_program, store_from_config
+from smk_tpu.utils.tracing import monotonic
 from smk_tpu.compile.store import ProgramStore
 
 
@@ -104,7 +104,7 @@ def precompile(
     )
 
     cfg = model.config
-    t0 = time.perf_counter()
+    t0 = monotonic()
     rec = stats if stats is not None else _Recorder()
     n_before = len(rec.programs)
     sd = store_dir or getattr(cfg, "compile_store_dir", None)
@@ -183,5 +183,5 @@ def precompile(
         "store_dir": store.root if store is not None else None,
         "n_programs": len(programs),
         "programs": programs,
-        "compile_s": round(time.perf_counter() - t0, 4),
+        "compile_s": round(monotonic() - t0, 4),
     }

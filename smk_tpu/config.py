@@ -401,6 +401,40 @@ class SMKConfig:
     compile_store_dir: str = None
     xla_cache_dir: str = None
 
+    # Unified run telemetry (ISSUE 10; smk_tpu/obs/) — all four knobs
+    # are pure observability: they are normalized out of the
+    # checkpoint run-identity hash AND the compile-store config
+    # digest (smk_tpu/compile/programs.py), and an armed run's draws
+    # are BIT-identical to an unarmed one (tests/test_obs.py, the OBS
+    # protocol's bit_identity record).
+    # - run_log_dir: when set, every fit writes one append-only JSONL
+    #   run log there (obs/events.py — nested spans with monotonic
+    #   wall bounds, chunk/fault/program/checkpoint events, typed
+    #   counters; summarize with `python -m smk_tpu.obs summarize`).
+    # - live_diagnostics: on-device streaming split-R-hat/batch-means
+    #   ESS over the kept-draw accumulators (obs/streaming.py),
+    #   fetched at every sampling-chunk boundary (8K bytes, through
+    #   the sanctioned `streaming_stats` transfer-ledger tag) and
+    #   threaded into the progress callback (`live_rhat_max` /
+    #   `live_ess_min`) and the run log — so a mixing failure
+    #   (ROADMAP item 4) is visible, and abortable via ProgressAbort,
+    #   at chunk granularity instead of after the full budget.
+    #   Implies chunked execution (the monitor lives at the chunk
+    #   boundary). The streaming R-hat equals the post-hoc
+    #   utils/diagnostics.rhat at the final boundary to fp tolerance;
+    #   the streaming ESS is a batch-means estimator (one batch per
+    #   chunk) — an order-of-magnitude health signal, NOT the
+    #   post-hoc Geyer number (documented tolerance in obs/streaming).
+    # - profile_dir / profile_chunks: jax.profiler capture-on-demand
+    #   (obs/profiling.py): capture the half-open chunk window
+    #   profile_chunks="a:b" into profile_dir. The SMK_PROFILE_DIR /
+    #   SMK_PROFILE_CHUNKS environment variables override both (point
+    #   them at a deployed fit without touching its config).
+    run_log_dir: str = None
+    live_diagnostics: bool = False
+    profile_dir: str = None
+    profile_chunks: str = None
+
     # Blocked-GEMM Cholesky for the phi-MH proposal factorization (the
     # one remaining O(m^3) kernel): 0 = XLA's native cholesky; > 0 =
     # ops/chol.py blocked_cholesky with this block size (the same
@@ -566,13 +600,31 @@ class SMKConfig:
                 "min_surviving_frac must be in (0, 1] — 0 would "
                 "accept a posterior built from zero subsets"
             )
-        for name in ("compile_store_dir", "xla_cache_dir"):
+        for name in (
+            "compile_store_dir", "xla_cache_dir", "run_log_dir",
+            "profile_dir",
+        ):
             v = getattr(self, name)
             if v is not None and not isinstance(v, str):
                 raise ValueError(
                     f"{name} must be a directory path string or "
                     f"None, got {v!r}"
                 )
+        if not isinstance(self.live_diagnostics, bool):
+            raise ValueError(
+                "live_diagnostics must be a bool, got "
+                f"{self.live_diagnostics!r}"
+            )
+        if self.profile_chunks is not None:
+            if not isinstance(self.profile_chunks, str):
+                raise ValueError(
+                    "profile_chunks must be a 'start[:stop]' string "
+                    f"or None, got {self.profile_chunks!r}"
+                )
+            # fail at construction, not mid-fit, on a typo'd window
+            from smk_tpu.obs.profiling import parse_chunk_range
+
+            parse_chunk_range(self.profile_chunks)
         if self.chol_block_size < 0:
             raise ValueError("chol_block_size must be >= 0 (0 = XLA)")
         if self.trisolve_block_size < 0:

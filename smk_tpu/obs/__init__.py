@@ -1,0 +1,41 @@
+"""Unified run-telemetry subsystem (ISSUE 10).
+
+Four pillars behind one package:
+
+- :mod:`smk_tpu.obs.events` — nested span/event model + per-fit
+  append-only JSONL run log (``SMKConfig.run_log_dir``);
+- :mod:`smk_tpu.obs.streaming` — on-device streaming split-R-hat /
+  batch-means ESS fetched at chunk boundaries
+  (``SMKConfig.live_diagnostics``);
+- :mod:`smk_tpu.obs.memory` — HBM watermark sampling per boundary;
+- :mod:`smk_tpu.obs.profiling` — ``jax.profiler`` capture-on-demand
+  over a chunk window + Chrome-trace summarization keyed to the
+  repo's named kernel scopes.
+
+CLI: ``python -m smk_tpu.obs summarize <run.jsonl>``
+(:mod:`smk_tpu.obs.summarize`).
+
+Hard invariants (tests/test_obs.py, OBS protocol): obs armed vs off
+is bit-identical (draws and program-cache keys unchanged), armed runs
+observe zero extra backend compiles on a warm model
+(recompile_guard-pinned), and the only new device-to-host fetch is
+the ledger-tagged ``streaming_stats`` site.
+"""
+
+from smk_tpu.obs.events import RunLog, open_run_log
+from smk_tpu.obs.memory import device_memory_stats, hbm_watermark
+from smk_tpu.obs.reporter import (
+    JsonlWriter,
+    read_jsonl,
+    write_records,
+)
+
+__all__ = [
+    "RunLog",
+    "open_run_log",
+    "device_memory_stats",
+    "hbm_watermark",
+    "JsonlWriter",
+    "read_jsonl",
+    "write_records",
+]

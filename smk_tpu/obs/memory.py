@@ -1,0 +1,59 @@
+"""HBM watermark sampling — ISSUE 10 pillar 3.
+
+``jax.Device.memory_stats()`` exposes the runtime allocator's live
+counters on backends that track them (TPU and GPU report
+``bytes_in_use`` / ``peak_bytes_in_use``); the CPU client returns
+None or an empty dict. The chunked executor samples this at every
+chunk boundary — a host-side dict read, no device work, no transfer
+— logging per-chunk watermarks next to the analytic bytes model
+bench.py already computes, so the "how close to HBM are we" question
+(ROADMAP items 1/5: chunk_size/K budgeting at north-star m) gets a
+measured answer instead of a model.
+
+Graceful everywhere: any backend that doesn't provide stats (or a
+device probe that throws) yields None and the telemetry simply omits
+the fields.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+def device_memory_stats(device=None) -> Optional[Dict[str, int]]:
+    """``{"bytes_in_use", "peak_bytes_in_use"}`` of ``device``
+    (default: first local device), or None when the backend exposes
+    no allocator stats (CPU) or the probe fails."""
+    try:
+        import jax
+
+        dev = device if device is not None else jax.local_devices()[0]
+        stats = dev.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    out: Dict[str, int] = {}
+    for key in ("bytes_in_use", "peak_bytes_in_use"):
+        v = stats.get(key)
+        if v is not None:
+            out[key] = int(v)
+    # some runtimes spell the peak differently; keep whatever
+    # bytes-ish fields exist rather than dropping the sample
+    if not out:
+        out = {
+            k: int(v)
+            for k, v in stats.items()
+            if isinstance(v, (int, float)) and "bytes" in k
+        }
+    return out or None
+
+
+def hbm_watermark(device=None) -> Dict[str, Any]:
+    """Boundary-sampling form: always a dict — ``{"available":
+    False}`` on statless backends, else the stats plus
+    ``available=True`` (the run-log/bench emission shape)."""
+    stats = device_memory_stats(device)
+    if stats is None:
+        return {"available": False}
+    return {"available": True, **stats}

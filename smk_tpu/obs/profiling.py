@@ -1,0 +1,256 @@
+"""Profiler capture-on-demand — ISSUE 10 pillar 4.
+
+Wraps ``jax.profiler`` for the two ways this repo profiles:
+
+- :class:`ProfilerCapture` — a chunk-windowed capture the chunked
+  executor (parallel/recovery.py) drives: armed by config
+  (``SMKConfig.profile_dir`` / ``profile_chunks``) or environment
+  (``SMK_PROFILE_DIR`` / ``SMK_PROFILE_CHUNKS``, which win), it
+  starts ``jax.profiler.start_trace`` at the first chunk of the
+  window and stops after the window's last boundary has synced — so
+  a production fit can be told "capture chunks 40:42" without any
+  code change, instead of re-running a hand-built harness.
+- trace-summary helpers — the Chrome-trace aggregation that
+  scripts/profile_trace.py hand-rolled: find the newest
+  ``*.trace.json.gz``, total device-side op durations, and extract
+  the named scopes the repo's kernels emit (``MTM_CHOL_SCOPE``,
+  ``FUSED_BUILD_SCOPE`` from utils/tracing.py) so an eff_tflops or
+  HBM claim can be attributed to exactly the op it names.
+
+Profiling is observational but NOT free (the profiler adds device
+callbacks while armed): captures never arm themselves — both the
+directory and the window must be requested — and the capture window
+is bounded by construction.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+import warnings
+from typing import Dict, Iterable, List, Optional, Tuple
+
+PROFILE_DIR_ENV = "SMK_PROFILE_DIR"
+PROFILE_CHUNKS_ENV = "SMK_PROFILE_CHUNKS"
+
+
+def parse_chunk_range(spec: Optional[str]) -> Optional[Tuple[int, int]]:
+    """``"a:b"`` -> (a, b) half-open chunk-index window; ``"a"`` ->
+    (a, a + 1). None/empty -> None. Raises ValueError on junk — a
+    typo'd window must fail loudly, not silently capture nothing."""
+    if spec is None or not str(spec).strip():
+        return None
+    s = str(spec).strip()
+    m = re.fullmatch(r"(\d+)(?::(\d+))?", s)
+    if m is None:
+        raise ValueError(
+            f"profile chunk range {spec!r} is not 'start' or "
+            "'start:stop' (half-open chunk indices)"
+        )
+    a = int(m.group(1))
+    b = int(m.group(2)) if m.group(2) is not None else a + 1
+    if b <= a:
+        raise ValueError(
+            f"profile chunk range {spec!r} is empty (stop <= start)"
+        )
+    return a, b
+
+
+class ProfilerCapture:
+    """One bounded ``jax.profiler`` window over a chunk range.
+
+    ``maybe_start(i)`` / ``maybe_stop(i)`` are called by the executor
+    at chunk ``i``'s dispatch and after its boundary sync
+    respectively; the trace runs over chunks [start, stop). ``close``
+    force-stops a window the run abandoned mid-capture (early abort,
+    quarantine death) so the trace file is still written."""
+
+    def __init__(self, out_dir: str, chunk_range: Tuple[int, int]):
+        self.out_dir = out_dir
+        self.start, self.stop = int(chunk_range[0]), int(chunk_range[1])
+        self.active = False
+        self.captured = False
+
+    @classmethod
+    def from_config(cls, cfg) -> Optional["ProfilerCapture"]:
+        """The armed capture a run should carry, or None. Environment
+        overrides config (the capture-on-demand path: point
+        SMK_PROFILE_DIR/SMK_PROFILE_CHUNKS at a deployed fit without
+        touching its config)."""
+        out_dir = os.environ.get(PROFILE_DIR_ENV) or getattr(
+            cfg, "profile_dir", None
+        )
+        spec = os.environ.get(PROFILE_CHUNKS_ENV) or getattr(
+            cfg, "profile_chunks", None
+        )
+        if not out_dir:
+            return None
+        rng = parse_chunk_range(spec) or (0, 1)
+        return cls(out_dir, rng)
+
+    def maybe_start(self, chunk_idx: int) -> bool:
+        if (
+            self.captured
+            or self.active
+            or not self.start <= chunk_idx < self.stop
+        ):
+            return False
+        import jax
+
+        os.makedirs(self.out_dir, exist_ok=True)
+        try:
+            jax.profiler.start_trace(self.out_dir)
+        except Exception as e:  # pragma: no cover - backend quirk
+            warnings.warn(
+                f"profiler capture failed to start ({e!r}); the run "
+                "continues unprofiled",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            self.captured = True  # don't retry every chunk
+            return False
+        self.active = True
+        return True
+
+    def maybe_stop(self, chunk_idx: int) -> bool:
+        """Stop once the window's last chunk has had its boundary
+        processed (the caller syncs on the boundary stats first, so
+        the captured device activity is complete)."""
+        if not self.active or chunk_idx < self.stop - 1:
+            return False
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:  # pragma: no cover - backend quirk
+            warnings.warn(
+                f"profiler capture failed to stop cleanly ({e!r})",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+        self.active = False
+        self.captured = True
+        return True
+
+    def close(self) -> None:
+        if self.active:
+            self.maybe_stop(self.stop)
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace summarization (shared with scripts/profile_trace.py)
+# ---------------------------------------------------------------------------
+
+
+def latest_chrome_trace(trace_dir: str) -> Optional[str]:
+    """Newest ``*.trace.json.gz`` under ``trace_dir`` (the profiler
+    writes one per capture session), or None."""
+    paths = sorted(
+        glob.glob(
+            os.path.join(trace_dir, "**", "*.trace.json.gz"),
+            recursive=True,
+        )
+    )
+    return paths[-1] if paths else None
+
+
+def load_trace_events(trace_path: str) -> List[dict]:
+    with gzip.open(trace_path, "rt") as f:
+        return json.load(f)["traceEvents"]
+
+
+def device_pids(events: Iterable[dict]) -> set:
+    """Process ids whose metadata names a device (TPU/stream) rather
+    than the python host — the pid filter every device-time
+    aggregation needs."""
+    pid_names = {
+        e["pid"]: e["args"]["name"]
+        for e in events
+        if e.get("ph") == "M"
+        and e.get("name") == "process_name"
+        and "args" in e
+    }
+    return {
+        p
+        for p, n in pid_names.items()
+        if re.search(r"TPU|device|/stream", n, re.I)
+        and not re.search(r"host|python", n, re.I)
+    }
+
+
+def device_op_totals(events: Iterable[dict]) -> Dict[str, float]:
+    """Total device-side duration (µs) per op name across complete
+    ('X') events on device pids."""
+    events = list(events)
+    pids = device_pids(events)
+    by_name: Dict[str, float] = {}
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in pids:
+            continue
+        dur = float(e.get("dur", 0.0))
+        if dur <= 0:
+            continue
+        by_name[e["name"]] = by_name.get(e["name"], 0.0) + dur
+    return by_name
+
+
+def scope_totals(
+    events: Iterable[dict], scopes: Optional[Iterable[str]] = None
+) -> Dict[str, float]:
+    """Total device µs attributed to each named profiler scope.
+
+    The repo's kernels emit ``jax.named_scope`` names
+    (utils/tracing.MTM_CHOL_SCOPE / FUSED_BUILD_SCOPE); XLA carries
+    them into op metadata, so a scope's time is the sum over device
+    ops whose name or ``args`` metadata mentions it. Default scopes
+    are exactly the repo's two named kernel scopes."""
+    if scopes is None:
+        from smk_tpu.utils.tracing import (
+            FUSED_BUILD_SCOPE,
+            MTM_CHOL_SCOPE,
+        )
+
+        scopes = (MTM_CHOL_SCOPE, FUSED_BUILD_SCOPE)
+    events = list(events)
+    pids = device_pids(events)
+    out = {s: 0.0 for s in scopes}
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in pids:
+            continue
+        dur = float(e.get("dur", 0.0))
+        if dur <= 0:
+            continue
+        hay = e.get("name", "")
+        args = e.get("args")
+        if isinstance(args, dict):
+            hay = hay + " " + " ".join(
+                str(v) for v in args.values()
+            )
+        for s in out:
+            if s in hay:
+                out[s] += dur
+    return out
+
+
+def summarize_trace(trace_dir: str) -> Optional[dict]:
+    """One-call summary of a capture directory: top device ops and
+    the named-scope attribution. None when no trace file exists."""
+    path = latest_chrome_trace(trace_dir)
+    if path is None:
+        return None
+    events = load_trace_events(path)
+    totals = device_op_totals(events)
+    top = sorted(totals.items(), key=lambda kv: -kv[1])[:20]
+    return {
+        "trace_path": path,
+        "device_us_total": round(sum(totals.values()), 1),
+        "top_ops_us": [
+            {"op": n[:80], "us": round(us, 1)} for n, us in top
+        ],
+        "scope_us": {
+            k: round(v, 1) for k, v in scope_totals(events).items()
+        },
+    }

@@ -1,0 +1,127 @@
+"""Append-atomic JSONL reporting — the one line-record writer.
+
+Every protocol artifact in this repo is a JSONL file (FAULTS_*,
+AOT_COMPILE_*, OBS_*, the per-fit run logs), and before ISSUE 10 each
+emitter hand-rolled its own ``open(path, "w"); f.write(json.dumps(r)
++ "\\n")`` loop (bench.py, scripts/chaos_probe.py,
+scripts/aot_probe.py, ...). This module is the shared implementation
+with the two properties the hand-rolled copies silently lacked:
+
+- **flush-per-record**: every record is flushed (and the default
+  writer fsync'd on close) the moment it is written, so a crashed or
+  killed process loses at most the record it was mid-writing — a
+  multi-minute probe that dies on leg 5 still ships legs 1-4;
+- **crash-truncation safety**: a torn trailing line (the half-written
+  record a kill strands) is skipped by :func:`read_jsonl` instead of
+  poisoning the whole file — readers see every complete record.
+
+Stdlib only: the run log (obs/events.py) writes through this from
+inside the chunked executor's host loop and must not import jax.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+from typing import Any, Dict, Iterable, List
+
+
+def _json_safe(obj):
+    """Strict-JSON value coercion: non-finite floats become null.
+    NaN is routine telemetry (a live ESS before two batches exist, a
+    single-chain R-hat before its second half fills), but a bare
+    ``NaN`` token is not valid JSON and breaks every non-Python
+    consumer (jq et al.) — null is the one spelling of "unavailable"
+    both sides agree on."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    return obj
+
+
+class JsonlWriter:
+    """Append-only JSONL file handle: one ``json.dumps`` line per
+    record — STRICT JSON (non-finite floats serialized as null, see
+    :func:`_json_safe`) — flushed per record, thread-safe (the
+    overlap pipeline's background checkpoint writer and the caller
+    thread both emit run log events). ``append=False`` (the probe
+    convention) truncates; ``append=True`` (the run-log convention)
+    extends an existing file."""
+
+    def __init__(self, path: str, *, append: bool = False):
+        self.path = path
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        self._f = open(path, "a" if append else "w", encoding="utf-8")
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def write(self, record: Dict[str, Any]) -> None:
+        """Write one record as one line and flush it to the OS — a
+        kill after this returns can only tear a LATER record."""
+        line = json.dumps(_json_safe(record), allow_nan=False) + "\n"
+        with self._lock:
+            if self._closed:
+                raise ValueError(
+                    f"JsonlWriter({self.path!r}) is closed"
+                )
+            self._f.write(line)
+            self._f.flush()
+
+    def close(self, *, fsync: bool = True) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            try:
+                self._f.flush()
+                if fsync:
+                    os.fsync(self._f.fileno())
+            finally:
+                self._f.close()
+
+    def __enter__(self) -> "JsonlWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def write_records(
+    path: str, records: Iterable[Dict[str, Any]]
+) -> None:
+    """One-shot protocol emission (the chaos/aot probe convention):
+    truncate ``path`` and write every record flush-per-record."""
+    with JsonlWriter(path) as w:
+        for r in records:
+            w.write(r)
+
+
+def read_jsonl(
+    path: str, *, strict: bool = False
+) -> List[Dict[str, Any]]:
+    """Every complete record in a JSONL file. A torn trailing line —
+    the crash-truncation residue flush-per-record bounds to at most
+    one — is skipped silently; a malformed line ANYWHERE ELSE means
+    the file was not written by this module's contract and raises
+    (``strict=True`` raises on the trailing line too)."""
+    out: List[Dict[str, Any]] = []
+    with open(path, "r", encoding="utf-8") as f:
+        lines = f.readlines()
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError as e:
+            if i == len(lines) - 1 and not strict:
+                continue  # torn trailing record: the documented loss
+            raise ValueError(
+                f"{path}:{i + 1}: malformed JSONL record ({e})"
+            ) from e
+    return out

@@ -1,0 +1,237 @@
+"""On-device streaming convergence monitoring — ISSUE 10 pillar 2.
+
+The chunked executor's whole point is that the host only ever sees
+K+4 bytes per boundary — but ROADMAP item 4's mixing failures
+(param_rhat_max 2.53-4.61 at config5 scale) are invisible until the
+multi-minute fit completes and finalize computes post-hoc
+diagnostics. This module keeps O(K * d_par) Welford/batch-means
+accumulators ON DEVICE, folds each sampling chunk's new kept draws in
+with one tiny jitted program (resolved through the L1 program lookup,
+``compile/programs.get_program``, so equal-length chunks share one
+compile and a warm model never recompiles per boundary), and lets the
+boundary fetch two (K,) vectors — per-subset ``rhat_max`` /
+``ess_min`` — through a ledger-tagged ``explicit_d2h`` site. A sick
+run shows up in the progress callback and run log at the NEXT chunk
+boundary, where a ``ProgressAbort`` can kill it before it burns its
+budget.
+
+Estimators (and the tolerance contract vs ``utils/diagnostics.py``,
+regression-tested in tests/test_obs.py):
+
+- **split-R-hat** — per split-half Welford moments (count/mean/M2 per
+  half, Chan-combined per chunk). Halves are the FIXED kept-index
+  ranges [0, n_kept//2) and [n_kept//2, 2*(n_kept//2)) per chain —
+  exactly the halves post-hoc ``diagnostics.rhat`` uses — so at the
+  FINAL boundary the streaming value equals the post-hoc one to fp
+  tolerance (documented: <= 1e-4 relative). Mid-run, halves have
+  unequal counts and the formula uses the populated halves' mean
+  count — an approximation that converges to the exact value as the
+  run completes. Single-chain runs report NaN until the second half
+  starts filling (one populated sequence has no between-variance);
+  multi-chain runs are informative from the first boundary (C
+  populated half-sequences).
+- **ESS** — batch means with ONE BATCH PER SAMPLING CHUNK (Welford
+  over per-chunk means): tau ≈ L̄ · var(batch means) / var(chain),
+  ESS = n/tau summed over chains, capped at n. This is a DIFFERENT
+  estimator from the post-hoc Geyer initial-positive-sequence ESS —
+  consistent when the chunk length far exceeds the autocorrelation
+  time, but expect finite-sample disagreement: the documented
+  tolerance is agreement within a factor of 3 on mixing chains (and
+  within ~2x on near-iid draws) ONCE ~10+ batches have accumulated —
+  with only a handful of chunks the batch-means variance itself is
+  noisy and the band can overshoot. An order-of-magnitude health
+  signal, not a publication number. Post-hoc ``effective_sample_size``
+  remains the number of record. NaN until two batches exist.
+
+Arming the monitor NEVER touches the chunk programs (separate XLA
+modules — the cross-mode bit-identity contract of
+parallel/recovery.py survives) and adds no D2H beyond the tagged
+stats fetch; draws are bit-identical armed vs off
+(tests/test_obs.py, OBS protocol).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class StreamState(NamedTuple):
+    """Device-resident accumulators. Leading dims are (K, C) — C = 1
+    for single-chain runs; the half axis (2) indexes the split-R-hat
+    halves."""
+
+    half_n: jnp.ndarray      # (K, C, 2) draw counts per half
+    half_mean: jnp.ndarray   # (K, C, 2, d) running means
+    half_m2: jnp.ndarray     # (K, C, 2, d) sum of squared deviations
+    n_batches: jnp.ndarray   # () number of chunk-batches folded in
+    n_total: jnp.ndarray     # () kept draws folded in, per chain
+    bm_mean: jnp.ndarray     # (K, C, d) Welford mean of batch means
+    bm_m2: jnp.ndarray       # (K, C, d) Welford M2 of batch means
+
+
+def init_stream(
+    k: int, n_chains: int, d: int, dtype=jnp.float32
+) -> StreamState:
+    """Zeroed accumulators on the default device."""
+    c = max(1, int(n_chains))
+    z = lambda *s: jnp.zeros(s, dtype)
+    return StreamState(
+        half_n=z(k, c, 2),
+        half_mean=z(k, c, 2, d),
+        half_m2=z(k, c, 2, d),
+        n_batches=z(),
+        n_total=z(),
+        bm_mean=z(k, c, d),
+        bm_m2=z(k, c, d),
+    )
+
+
+def make_stream_update(n_half: int, n_chains: int):
+    """Build the per-chunk fold-in: ``update(stream, chunk, offset)``
+    where ``chunk`` is the boundary's new kept-draw slice — (K, L, d)
+    single-chain or (K, C, L, d) — and ``offset`` is the (traced)
+    global kept-iteration index of its first row. One compiled
+    program per chunk length L; the offset is traced so every
+    boundary of equal length shares it (the same bucketing discipline
+    as recovery._slice_draws)."""
+
+    def update(
+        stream: StreamState, chunk: jnp.ndarray, offset
+    ) -> StreamState:
+        x = chunk if chunk.ndim == 4 else chunk[:, None]  # (K,C,L,d)
+        dt = stream.half_mean.dtype
+        x = x.astype(dt)
+        length = x.shape[2]
+        idx = jnp.asarray(offset, jnp.int32) + jnp.arange(
+            length, dtype=jnp.int32
+        )
+        # half of each row by its GLOBAL kept index — rows past
+        # 2*n_half (the odd-length leftover post-hoc rhat also
+        # ignores) belong to neither half
+        half_id = jnp.where(
+            idx < n_half, 0, jnp.where(idx < 2 * n_half, 1, -1)
+        )
+        one = jnp.asarray(1.0, dt)
+
+        def fold_half(h: int):
+            msk = (half_id == h).astype(dt)  # (L,)
+            cnt = jnp.sum(msk)
+            safe = jnp.maximum(cnt, one)
+            mean_c = jnp.einsum("l,kcld->kcd", msk, x) / safe
+            dev = x - mean_c[:, :, None, :]
+            m2_c = jnp.einsum("l,kcld->kcd", msk, dev * dev)
+            # Chan parallel combine with the accumulator
+            n_a = stream.half_n[:, :, h]          # (K, C)
+            mean_a = stream.half_mean[:, :, h]    # (K, C, d)
+            m2_a = stream.half_m2[:, :, h]
+            n_new = n_a + cnt
+            safe_n = jnp.maximum(n_new, one)[..., None]
+            delta = mean_c - mean_a
+            mean_new = mean_a + delta * (cnt / safe_n)
+            m2_new = (
+                m2_a + m2_c
+                + delta * delta * (n_a[..., None] * cnt / safe_n)
+            )
+            return n_new, mean_new, m2_new
+
+        n0, mu0, m20 = fold_half(0)
+        n1, mu1, m21 = fold_half(1)
+        # one batch per chunk (over ALL its rows) for batch-means ESS
+        bm = jnp.mean(x, axis=2)  # (K, C, d)
+        nb = stream.n_batches + one
+        delta_b = bm - stream.bm_mean
+        bm_mean = stream.bm_mean + delta_b / nb
+        bm_m2 = stream.bm_m2 + delta_b * (bm - bm_mean)
+        return StreamState(
+            half_n=jnp.stack([n0, n1], axis=2),
+            half_mean=jnp.stack([mu0, mu1], axis=2),
+            half_m2=jnp.stack([m20, m21], axis=2),
+            n_batches=nb,
+            n_total=stream.n_total + jnp.asarray(length, dt),
+            bm_mean=bm_mean,
+            bm_m2=bm_m2,
+        )
+
+    del n_chains  # the chain axis rides in the array shapes
+    return update
+
+
+def make_stream_stats(n_chains: int):
+    """Build the boundary stats program: ``stats(stream)`` returns
+    ``(rhat, ess, rhat_max, ess_min)`` — (K, d) per-parameter values
+    plus the (K,) per-subset reductions the executor actually fetches
+    (8K bytes through the ``streaming_stats`` ledger tag)."""
+
+    def stats(stream: StreamState):
+        dt = stream.half_mean.dtype
+        one = jnp.asarray(1.0, dt)
+        tiny = jnp.asarray(1e-30, dt)
+        nan = jnp.asarray(jnp.nan, dt)
+
+        n_h = stream.half_n                      # (K, C, 2)
+        pop = (n_h >= 2.0).astype(dt)            # populated halves
+        m_pop = jnp.sum(pop, axis=(1, 2))        # (K,)
+        safe_pop = jnp.maximum(m_pop, one)[:, None]
+        var_h = stream.half_m2 / jnp.maximum(n_h - 1.0, one)[..., None]
+        w = pop[..., None]
+        within = jnp.sum(w * var_h, axis=(1, 2)) / safe_pop  # (K, d)
+        mu = jnp.sum(w * stream.half_mean, axis=(1, 2)) / safe_pop
+        dev = stream.half_mean - mu[:, None, None, :]
+        b_var = jnp.sum(w * dev * dev, axis=(1, 2)) / jnp.maximum(
+            m_pop - 1.0, one
+        )[:, None]
+        n_bar = (jnp.sum(pop * n_h, axis=(1, 2)) / jnp.maximum(
+            m_pop, one
+        ))[:, None]
+        var_est = (n_bar - 1.0) / jnp.maximum(n_bar, one) * within + b_var
+        rhat = jnp.sqrt(var_est / jnp.maximum(within, tiny))
+        rhat = jnp.where(m_pop[:, None] >= 2.0, rhat, nan)
+
+        # per-chain overall variance: Chan-combine the two halves
+        n_c = jnp.sum(n_h, axis=2)               # (K, C)
+        safe_c = jnp.maximum(n_c, one)[..., None]
+        mean_c = jnp.sum(
+            n_h[..., None] * stream.half_mean, axis=2
+        ) / safe_c
+        dev_h = stream.half_mean - mean_c[:, :, None, :]
+        m2_c = jnp.sum(
+            stream.half_m2 + n_h[..., None] * dev_h * dev_h, axis=2
+        )
+        var_c = m2_c / jnp.maximum(n_c - 1.0, one)[..., None]
+
+        nb = stream.n_batches
+        n_tot = stream.n_total
+        var_bm = stream.bm_m2 / jnp.maximum(nb - 1.0, one)
+        l_bar = n_tot / jnp.maximum(nb, one)
+        tau = l_bar * var_bm / jnp.maximum(var_c, tiny)
+        ess_c = n_tot / jnp.maximum(tau, one / jnp.maximum(n_tot, one))
+        ess_c = jnp.minimum(ess_c, n_tot)
+        ess = jnp.sum(ess_c, axis=1)             # (K, d)
+        ess = jnp.where(nb >= 2.0, ess, nan)
+
+        return rhat, ess, jnp.max(rhat, axis=1), jnp.min(ess, axis=1)
+
+    del n_chains
+    return stats
+
+
+def stream_diagnostics(
+    stream: StreamState,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side convenience: the full (K, d) streaming R-hat / ESS
+    of an accumulator state (the regression tests' comparison hook —
+    the executor itself fetches only the (K,) reductions)."""
+    rhat, ess, _, _ = jax.jit(make_stream_stats(0))(stream)
+    return np.asarray(rhat), np.asarray(ess)
+
+
+# Bytes of the executor's per-boundary streaming fetch: two (K,) f32
+# vectors (rhat_max, ess_min) — the ledger-tag contract constant
+# shared by the emitting site (parallel/recovery.py) and the
+# transfer tests, so the accounting cannot drift.
+def fetch_nbytes(k: int) -> int:
+    return 8 * int(k)

@@ -21,8 +21,8 @@ Here both durability subsystems are real:
 
 from __future__ import annotations
 
+import contextlib
 import os
-import time
 import warnings
 import zlib
 from functools import partial as _partial
@@ -60,7 +60,7 @@ from smk_tpu.utils.checkpoint import (
     save_segment,
     segment_path,
 )
-from smk_tpu.utils.tracing import ChunkPipelineStats
+from smk_tpu.utils.tracing import ChunkPipelineStats, monotonic
 
 
 # Checkpoint format version. v2 added the run-identity fingerprint;
@@ -365,6 +365,13 @@ def _run_identity(cfg, key, data, beta_init) -> np.ndarray:
         # code) — resuming with/without a store must be legal
         compile_store_dir=None,
         xla_cache_dir=None,
+        # observability (ISSUE 10) watches the chain, never steers it
+        # — a run checkpointed with the run log / live diagnostics /
+        # profiler armed must resume with them off and vice versa
+        run_log_dir=None,
+        live_diagnostics=False,
+        profile_dir=None,
+        profile_chunks=None,
     )
     crcs = [zlib.crc32(repr(cfg_ident).encode())]
     crcs.append(zlib.crc32(_key_bytes(key)))
@@ -707,7 +714,7 @@ class _SegmentedCheckpoint:
     def _write(self, state_np, seg, it: int, fault=None) -> None:
         """One boundary's I/O: optional new segment, then manifest.
         ``seg`` is None (burn boundary) or (param, w, start, stop)."""
-        t0 = time.perf_counter()
+        t0 = monotonic()
         nbytes = 0
         if seg is not None:
             param, w, start, stop = seg
@@ -721,7 +728,7 @@ class _SegmentedCheckpoint:
         nbytes += self._write_manifest(state_np, it, fault)
         if self.pstats is not None:
             self.pstats.add_ckpt_write(
-                time.perf_counter() - t0, nbytes
+                monotonic() - t0, nbytes
             )
 
     def _write_full(self, state_np, param, w, it: int, filled: int):
@@ -734,7 +741,7 @@ class _SegmentedCheckpoint:
         garbage, overwritten by the next full rewrite). Only after
         the new manifest is on disk are the superseded segment files
         unlinked (best-effort; stale files are harmless)."""
-        t0 = time.perf_counter()
+        t0 = monotonic()
         nbytes = 0
         old = range(self.seg_base, self.seg_base + self.n_segments)
         new_base = self.seg_base + self.n_segments
@@ -755,7 +762,7 @@ class _SegmentedCheckpoint:
                 pass
         if self.pstats is not None:
             self.pstats.add_ckpt_write(
-                time.perf_counter() - t0, nbytes
+                monotonic() - t0, nbytes
             )
 
     # ---- boundary entry point (caller thread) --------------------
@@ -886,6 +893,81 @@ def fit_subsets_chunked(
     stop_after_chunks: Optional[int] = None,
     nan_guard: bool = False,
     pipeline_stats: Optional[ChunkPipelineStats] = None,
+) -> Optional[SubsetResult]:
+    """Run-log arming wrapper over :func:`_fit_subsets_chunked_impl`
+    (which carries the full executor docstring).
+
+    Observability plumbing (ISSUE 10): when the caller's
+    ``pipeline_stats`` already carries a run log (api.fit_meta_kriging
+    armed one), the executor's spans/events nest inside the caller's
+    open span; when ``model.config.run_log_dir`` is set and no log is
+    active, this wrapper opens one per fit — root span
+    ``fit_subsets_chunked`` — and closes it on every exit path, so a
+    standalone executor run (bench.py's public rungs) gets a complete
+    timeline too."""
+    cfg = model.config
+    pstats = pipeline_stats
+    run_log = pstats.run_log if pstats is not None else None
+    if run_log is not None or not cfg.run_log_dir:
+        return _fit_subsets_chunked_impl(
+            model, part, coords_test, x_test, key, beta_init,
+            chunk_iters=chunk_iters, checkpoint_path=checkpoint_path,
+            mesh=mesh, chunk_size=chunk_size, progress=progress,
+            stop_after_chunks=stop_after_chunks, nan_guard=nan_guard,
+            pipeline_stats=pstats, run_log=run_log,
+        )
+    from smk_tpu.obs.events import open_run_log
+
+    run_log = open_run_log(
+        cfg.run_log_dir,
+        name="fit_subsets_chunked",
+        meta={
+            "n_subsets": part.n_subsets,
+            "n_samples": cfg.n_samples,
+            "chunk_iters": chunk_iters,
+            "chunk_pipeline": cfg.chunk_pipeline,
+            "fault_policy": cfg.fault_policy,
+        },
+    )
+    if pstats is None:
+        # events need a stats sink to flow through; an internal one is
+        # invisible to the caller but feeds the run log
+        pstats = ChunkPipelineStats()
+    pstats.run_log = run_log
+    try:
+        with run_log.span(
+            "fit_subsets_chunked", n_subsets=part.n_subsets
+        ):
+            return _fit_subsets_chunked_impl(
+                model, part, coords_test, x_test, key, beta_init,
+                chunk_iters=chunk_iters,
+                checkpoint_path=checkpoint_path,
+                mesh=mesh, chunk_size=chunk_size, progress=progress,
+                stop_after_chunks=stop_after_chunks,
+                nan_guard=nan_guard,
+                pipeline_stats=pstats, run_log=run_log,
+            )
+    finally:
+        run_log.close()
+
+
+def _fit_subsets_chunked_impl(
+    model: SpatialGPSampler,
+    part: Partition,
+    coords_test: jnp.ndarray,
+    x_test: jnp.ndarray,
+    key: jax.Array,
+    beta_init: Optional[jnp.ndarray] = None,
+    *,
+    chunk_iters: int = 500,
+    checkpoint_path: Optional[str] = None,
+    mesh=None,
+    chunk_size: Optional[int] = None,
+    progress=None,
+    stop_after_chunks: Optional[int] = None,
+    nan_guard: bool = False,
+    pipeline_stats: Optional[ChunkPipelineStats] = None,
+    run_log=None,
 ) -> Optional[SubsetResult]:
     """Unified chunked K-subset executor: the whole MCMC (burn-in AND
     sampling) runs as a host loop of ``chunk_iters``-long compiled
@@ -1244,6 +1326,107 @@ def fit_subsets_chunked(
         if want_stats
         else None
     )
+
+    # ---- observability arming (ISSUE 10, smk_tpu/obs/) ------------
+    # Streaming convergence monitor: O(K * d_par) Welford/batch-means
+    # accumulators ON DEVICE, folded forward at every sampling-chunk
+    # boundary by a tiny per-length program resolved through the same
+    # L1 lookup as the chunk programs (equal-length chunks share one
+    # compile; a warm model never recompiles per boundary). The only
+    # host traffic is the per-boundary (K,)+(K,) rhat_max/ess_min
+    # fetch through the sanctioned `streaming_stats` ledger tag. The
+    # chunk programs are untouched (separate XLA modules), so armed
+    # runs stay bit-identical to unarmed ones.
+    stream = None
+    stream_update = stream_stats_fn = None
+    stream_nbytes = 0
+    if cfg.live_diagnostics:
+        from smk_tpu.obs.streaming import (
+            fetch_nbytes,
+            init_stream,
+            make_stream_stats,
+            make_stream_update,
+        )
+
+        n_half_stream = n_kept // 2
+
+        def stream_update(length: int):
+            return _cached_program(
+                model,
+                compile_programs.aux_bucket_key(
+                    model, "stream", length, k, d_par
+                ),
+                lambda: jax.jit(
+                    make_stream_update(n_half_stream, cfg.n_chains)
+                ),
+                stats=pstats,
+            )
+
+        stream_stats_fn = _cached_program(
+            model,
+            compile_programs.aux_bucket_key(
+                model, "stream_stats", k, d_par
+            ),
+            lambda: jax.jit(make_stream_stats(cfg.n_chains)),
+            stats=pstats,
+        )
+        stream_nbytes = fetch_nbytes(k)
+        stream = init_stream(k, cfg.n_chains, d_par, dtype)
+        filled_now = max(0, it - cfg.n_burn_in)
+        if filled_now > 0 and not holes:
+            # resume backfill: replay the already-filled kept region
+            # through the SAME per-length update programs the ongoing
+            # run uses (the historical chunk layout is recomputed from
+            # (n_burn_in, chunk_iters), so no new length buckets — and
+            # no new compiles beyond the run's own — are introduced)
+            ofs = 0
+            while ofs < filled_now:
+                ln = min(
+                    chunk_iters,
+                    cfg.n_samples - cfg.n_burn_in - ofs,
+                    filled_now - ofs,
+                )
+                o_dev = _slice_offset(ofs)
+                stream = stream_update(ln)(
+                    stream,
+                    _slice_draws(param_draws, o_dev, ln),
+                    o_dev,
+                )
+                ofs += ln
+        elif holes:
+            warnings.warn(
+                "live_diagnostics on a lenient (hole) resume covers "
+                "only draws sampled after the resume — the surviving "
+                "segments are not replayed into the streaming "
+                "accumulators while corrupt ranges await refill "
+                "(obs/streaming.py)",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+
+    # HBM watermark sampling at chunk boundaries (graceful None on
+    # statless backends — the first empty probe disables the rest)
+    mem_sample = None
+    if pstats is not None:
+        from smk_tpu.obs.memory import device_memory_stats
+
+        _mem_live = [True]
+
+        def mem_sample():
+            if not _mem_live[0]:
+                return None
+            s = device_memory_stats()
+            if s is None:
+                _mem_live[0] = False
+            return s
+
+    # profiler capture-on-demand over a chunk window (config fields
+    # profile_dir/profile_chunks; SMK_PROFILE_DIR/SMK_PROFILE_CHUNKS
+    # override) — None unless explicitly armed
+    from smk_tpu.obs.profiling import ProfilerCapture
+
+    prof = ProfilerCapture.from_config(cfg)
+
     warned_progress = [False]
 
     def call_progress(info):
@@ -1268,7 +1451,7 @@ def fit_subsets_chunked(
                     stacklevel=2,
                 )
 
-    def report(phase, it_end, window_start, accept_mean):
+    def report(phase, it_end, window_start, accept_mean, live=None):
         pe = cfg.phi_update_every
         # phi updates land on global iterations i = 0 (mod pe); the
         # accept counter covers [window_start, it_end) — the window
@@ -1278,12 +1461,20 @@ def fit_subsets_chunked(
         n_updates = max(
             1, -(-it_end // pe) - -(-window_start // pe)
         )
-        call_progress({
+        info = {
             "phase": phase,
             "iteration": it_end,
             "n_samples": cfg.n_samples,
             "phi_accept_rate": float(accept_mean) / n_updates,
-        })
+        }
+        if live is not None:
+            # the streaming-diagnostics verdict of THIS boundary
+            # (obs/streaming.py): worst split-R-hat / smallest ESS
+            # across subsets and parameters — a callback may raise a
+            # ProgressAbort subclass on a sick value and kill the run
+            # before it burns its remaining budget
+            info["live_rhat_max"], info["live_ess_min"] = live
+        call_progress(info)
 
     # The chunk schedule is fully determined by (it, chunk_iters):
     # both pipeline modes execute exactly this plan, so the compiled
@@ -1326,7 +1517,7 @@ def fit_subsets_chunked(
         truncated = True
 
     stats_bytes = k + 4  # (K,) bool + one f32 scalar per boundary
-    t_loop0 = time.perf_counter()
+    t_loop0 = monotonic()
     refork = (
         _cached_program(
             model, _refork_key(model, k, m, q, p),
@@ -1468,7 +1659,8 @@ def fit_subsets_chunked(
         on chunk b's own tiny stats — which are ready the moment the
         chunk finishes.
         """
-        t0 = time.perf_counter()
+        t0 = monotonic()
+        accept = None
         if b["stats"] is not None:
             # the ONE sanctioned guard/report fetch per boundary —
             # K+4 bytes, declared to transfer_guard_strict
@@ -1487,25 +1679,66 @@ def fit_subsets_chunked(
                     # precedes the failure"
                     writer.flush()
                 raise SubsetNaNError(np.where(~finite)[0], b["it"])
-            if b["phase"] != "fill":
-                # refill chunks run PAST n_samples at hole offsets —
-                # feeding them to the user progress callback would
-                # break its documented contract (phases burn/sample,
-                # iteration <= n_samples, monotone progress)
-                report(b["phase"], b["it"], b["window_start"], accept)
+        live_vals = None
+        if b.get("live") is not None:
+            # streaming-diagnostics fetch (ISSUE 10): two (K,) f32
+            # vectors, the ONLY D2H obs adds to the hot loop —
+            # ledger-tagged so the transfer contract stays exact
+            # (tests/test_sanitizers.py)
+            with explicit_d2h(
+                "streaming_stats", nbytes=stream_nbytes
+            ):
+                live_rh = np.asarray(b["live"][0])
+                live_es = np.asarray(b["live"][1])
+            live_vals = (
+                float(np.nanmax(live_rh))
+                if np.isfinite(live_rh).any() else float("nan"),
+                float(np.nanmin(live_es))
+                if np.isfinite(live_es).any() else float("nan"),
+            )
+            if run_log is not None:
+                run_log.event(
+                    "live_diagnostics", iteration=b["it"],
+                    rhat_max=live_rh, ess_min=live_es,
+                )
+        if b["stats"] is not None and b["phase"] != "fill":
+            # refill chunks run PAST n_samples at hole offsets —
+            # feeding them to the user progress callback would
+            # break its documented contract (phases burn/sample,
+            # iteration <= n_samples, monotone progress)
+            report(
+                b["phase"], b["it"], b["window_start"], accept,
+                live=live_vals,
+            )
         if ck is not None and b["save"]:
             ck.save(
                 b["state_src"], b["seg_src"], b["it"], b["filled"]
             )
-        host_s = time.perf_counter() - t0
+        host_s = monotonic() - t0
         if pstats is not None:
-            pstats.record_chunk(
+            entry = dict(
                 chunk=b["index"], phase=b["phase"], n_iters=b["n"],
                 iteration=b["it"], dispatch_s=b["dispatch_s"],
                 host_work_s=host_s,
                 host_stall_s=host_s if stall else 0.0,
                 d2h_bytes=b["d2h_bytes"],
             )
+            if live_vals is not None:
+                entry["live_rhat_max"] = live_vals[0]
+                entry["live_ess_min"] = live_vals[1]
+            mem = mem_sample() if mem_sample is not None else None
+            if mem is not None:
+                entry["hbm_bytes_in_use"] = mem.get("bytes_in_use")
+                entry["hbm_peak_bytes"] = mem.get(
+                    "peak_bytes_in_use", mem.get("bytes_in_use")
+                )
+            pstats.record_chunk(**entry)
+        if prof is not None and prof.maybe_stop(b["index"]):
+            if run_log is not None:
+                run_log.event(
+                    "profile_stop", chunk=b["index"],
+                    out_dir=prof.out_dir,
+                )
 
     def boundary_record(index, kind, start, n, dispatch_s):
         """Capture everything chunk (start, n)'s host work needs,
@@ -1515,7 +1748,7 @@ def fit_subsets_chunked(
         draw writes deliberately skip the per-boundary append path
         (segments must stay contiguous) — the post-refill
         rewrite_full publishes them in one merged segment."""
-        nonlocal state
+        nonlocal state, stream
         it_end = start + n
         phase = {"burn": "burn", "fill": "fill"}.get(kind, "sample")
         stats = stats_fn(state) if want_stats else None
@@ -1525,6 +1758,30 @@ def fit_subsets_chunked(
                 start_copy = getattr(leaf, "copy_to_host_async", None)
                 if start_copy is not None:
                     start_copy()
+        # streaming-diagnostics fold-in (ISSUE 10): dispatched right
+        # behind the chunk, so its tiny programs complete with the
+        # chunk and the boundary fetch never stalls on the NEXT
+        # chunk's compute. stream_prev is kept per boundary — jax
+        # arrays are immutable, so a quarantine rewind restores the
+        # monitor by reference, no clone needed. Refill chunks are
+        # skipped (their rows are published by the terminal rewrite).
+        stream_prev = stream
+        live = None
+        if stream is not None and kind == "samp":
+            o_dev = _slice_offset(start - n_burn)
+            stream = stream_update(n)(
+                stream, _slice_draws(param_draws, o_dev, n), o_dev
+            )
+            s_out = stream_stats_fn(stream)
+            live = (s_out[2], s_out[3])
+            if mode == "overlap":
+                for leaf in live:
+                    # smklint: disable=SMK104 -- fresh outputs of the stream stats jit, never donated
+                    start_copy = getattr(
+                        leaf, "copy_to_host_async", None
+                    )
+                    if start_copy is not None:
+                        start_copy()
         if kind == "burn" and it_end == n_burn:
             # post-burn-in acceptance accounting, as burn_in() does —
             # BEFORE the checkpoint snapshot (the saved boundary state
@@ -1537,6 +1794,8 @@ def fit_subsets_chunked(
         filled = max(0, it_end - n_burn)
         state_src = seg_src = None
         d2h = stats_bytes if stats is not None else 0
+        if live is not None:
+            d2h += stream_nbytes
         if ck is not None and kind != "fill":
             if mode == "overlap":
                 state_src = HostSnapshot(state)
@@ -1563,6 +1822,7 @@ def fit_subsets_chunked(
             "seg_src": seg_src, "filled": filled,
             "save": kind != "fill",
             "dispatch_s": dispatch_s, "d2h_bytes": d2h,
+            "live": live, "stream_prev": stream_prev,
         }
 
     def apply_rewind(b, rw):
@@ -1573,7 +1833,13 @@ def fit_subsets_chunked(
         — share-nothing purity), and move the iteration clock back.
         The replay re-dispatches the SAME cached compiled program:
         zero recompiles across quarantine transitions."""
-        nonlocal state, it
+        nonlocal state, it, stream
+        if stream is not None:
+            # the monitor must forget every fold-in at or after the
+            # rewound chunk (including an in-flight overlap
+            # successor's) — jax arrays are immutable, so the
+            # boundary's pre-update reference IS the rewound state
+            stream = b.get("stream_prev", stream)
         state = refork(
             b["held"],
             jnp.asarray(rw.retry_mask),
@@ -1591,18 +1857,36 @@ def fit_subsets_chunked(
     # rows are overwritten on replay) and re-runs from the held
     # state. With fault_policy="abort" this executes exactly the
     # historical schedule: same dispatches, same boundary order.
+    _loop_span = None
+    if run_log is not None:
+        run_log.event(
+            "plan", n_chunks=len(plan), chunk_iters=chunk_iters,
+            mode=mode, fault_policy=cfg.fault_policy,
+            n_holes=len(holes), truncated=truncated,
+            resumed_at_iteration=it,
+        )
+        _loop_span = run_log.span(
+            "chunk_loop", n_chunks=len(plan), mode=mode
+        )
+        _loop_span.__enter__()
     try:
         idx = 0
         pending = None
         while True:
             if idx < len(plan):
                 kind, start, n, w_ofs = plan[idx]
-                t0 = time.perf_counter()
+                t0 = monotonic()
+                if prof is not None and prof.maybe_start(idx):
+                    if run_log is not None:
+                        run_log.event(
+                            "profile_start", chunk=idx,
+                            out_dir=prof.out_dir,
+                        )
                 held = _held_clone(state) if policy_q else None
                 dispatch(kind, start, n, w_ofs)
                 b = boundary_record(
                     idx, kind, start, n,
-                    time.perf_counter() - t0,
+                    monotonic() - t0,
                 )
                 b["held"] = held
                 b["start"] = start
@@ -1628,14 +1912,14 @@ def fit_subsets_chunked(
                 idx = todo["index"]
                 pending = None
         if ck is not None and mode == "overlap":
-            t0 = time.perf_counter()
+            t0 = monotonic()
             ck.ensure_synced(state, it, max(0, it - n_burn))
             if pstats is not None:
                 pstats.record_chunk(
                     chunk=len(plan), phase="drain", n_iters=0,
                     iteration=it, dispatch_s=0.0,
-                    host_work_s=time.perf_counter() - t0,
-                    host_stall_s=time.perf_counter() - t0,
+                    host_work_s=monotonic() - t0,
+                    host_stall_s=monotonic() - t0,
                     d2h_bytes=0,
                 )
         if holes and not truncated and ck is not None:
@@ -1649,26 +1933,36 @@ def fit_subsets_chunked(
                 state, param_np, w_np, cfg.n_samples, n_kept
             )
     finally:
+        if prof is not None:
+            prof.close()
+        if _loop_span is not None:
+            _loop_span.__exit__(None, None, None)
         if writer is not None:
             writer.close()
         if pstats is not None:
-            pstats.total_wall_s = time.perf_counter() - t_loop0
+            pstats.total_wall_s = monotonic() - t_loop0
 
     if truncated:
         return None
 
-    finalize = _cached_program(
-        model, _finalize_key(model, k, m, q, n_kept, d_par, d_w),
-        lambda: jax.jit(jax.vmap(model.finalize)),
-        store=store,
-        lower_args=(
-            (init_like, param_draws, w_draws)
-            if store is not None
-            else None
-        ),
-        stats=pstats,
+    fin_span = (
+        run_log.span("finalize")
+        if run_log is not None
+        else contextlib.nullcontext()
     )
-    return finalize(state, param_draws, w_draws)
+    with fin_span:
+        finalize = _cached_program(
+            model, _finalize_key(model, k, m, q, n_kept, d_par, d_w),
+            lambda: jax.jit(jax.vmap(model.finalize)),
+            store=store,
+            lower_args=(
+                (init_like, param_draws, w_draws)
+                if store is not None
+                else None
+            ),
+            stats=pstats,
+        )
+        return finalize(state, param_draws, w_draws)
 
 
 def fit_subsets_checkpointed(

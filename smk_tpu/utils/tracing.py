@@ -48,6 +48,17 @@ def fused_build_scope():
     return jax.named_scope(FUSED_BUILD_SCOPE)
 
 
+def monotonic() -> float:
+    """The repo's one telemetry clock (SMK110 telemetry-discipline):
+    monotonic seconds, suspend/NTP-step-proof for interval math.
+    Library code outside smk_tpu/obs/ and this module must take its
+    timestamps from here (or emit through phase_timer /
+    ChunkPipelineStats / the run log) instead of calling
+    time.perf_counter()/time.time() directly — one span source of
+    truth, lintable (smk_tpu/analysis/rules.py SMK110)."""
+    return time.perf_counter()
+
+
 def device_sync(tree: Any) -> None:
     """Force real completion of every array in ``tree``.
 
@@ -116,6 +127,15 @@ class ChunkPipelineStats:
     ladder and were dropped (``dropped``), and the per-subset attempt
     counts at that moment — so a bench record or protocol can report
     the full retry history, not just the survivor set.
+
+    Run-log emission (ISSUE 10): when ``run_log`` is set (an
+    obs/events.RunLog, duck-typed so this module stays importable
+    without obs), every record_* call also appends one typed event to
+    the fit's JSONL timeline — chunk/fault/program/ckpt_write — so
+    the run log is the superset view `python -m smk_tpu.obs
+    summarize` reconstructs. All record_* paths are serialized on the
+    one internal lock: the overlap pipeline's background checkpoint
+    writer emits from its own thread.
     """
 
     mode: str = "sync"
@@ -127,12 +147,29 @@ class ChunkPipelineStats:
     ckpt_bytes: int = 0
     ckpt_boundary_bytes: List[int] = field(default_factory=list)
     total_wall_s: float = 0.0
+    run_log: Any = None
     _lock: threading.Lock = field(
         default_factory=threading.Lock, repr=False
     )
+    # keyed dedup set for record_program — the list alone made every
+    # acquisition a linear scan over all prior records, O(n^2) across
+    # a long run's dispatch loop (ISSUE 10 satellite)
+    _program_keys: set = field(default_factory=set, repr=False)
+
+    def _emit(self, name: str, attrs: Dict[str, Any]) -> None:
+        """Forward one record to the run log (caller holds _lock);
+        a log failure must never kill the fit being observed."""
+        if self.run_log is None:
+            return
+        try:
+            self.run_log.event(name, **attrs)
+        except Exception:  # pragma: no cover - defensive
+            self.run_log = None
 
     def record_chunk(self, **entry: Any) -> None:
-        self.chunks.append(entry)
+        with self._lock:
+            self.chunks.append(entry)
+            self._emit("chunk", entry)
 
     def record_fault(
         self,
@@ -154,7 +191,7 @@ class ChunkPipelineStats:
         replay (a transient fault may recover there, a deterministic
         one dies at the next boundary). ``attempts`` maps each
         involved subset to its attempt count so far."""
-        self.fault_events.append({
+        ev = {
             "chunk": int(chunk),
             "iteration": int(iteration),
             "phase": phase,
@@ -162,7 +199,10 @@ class ChunkPipelineStats:
             "dropped": [int(j) for j in dropped],
             "deferred": [int(j) for j in deferred],
             "attempts": {int(j): int(n) for j, n in attempts.items()},
-        })
+        }
+        with self._lock:
+            self.fault_events.append(ev)
+            self._emit("fault", ev)
 
     def record_program(
         self, *, key, source: str, compile_s: float = 0.0,
@@ -178,16 +218,22 @@ class ChunkPipelineStats:
         deserialize; 0.0 for lazy jit builds, whose compile lands
         inside their first dispatch). The first record per key wins —
         the executor re-resolves programs every dispatch, and only
-        the acquisition is provenance."""
-        key_l = [str(f) for f in key]
-        if any(p["key"] == key_l for p in self.programs):
-            return
-        self.programs.append({
-            "key": key_l,
+        the acquisition is provenance. Dedup is a keyed-set lookup —
+        the old any()-over-list scan was O(n) per record, O(n^2)
+        over the dispatch loop (ISSUE 10 satellite)."""
+        key_t = tuple(str(f) for f in key)
+        entry = {
+            "key": list(key_t),
             "source": source,
             "compile_s": round(float(compile_s), 4),
             "aot": bool(aot),
-        })
+        }
+        with self._lock:
+            if key_t in self._program_keys:
+                return
+            self._program_keys.add(key_t)
+            self.programs.append(entry)
+            self._emit("program", entry)
 
     def program_summary(self) -> Dict[str, Any]:
         """Compile telemetry compressed for a bench record: total
@@ -207,6 +253,11 @@ class ChunkPipelineStats:
             self.ckpt_write_s += float(seconds)
             self.ckpt_bytes += int(nbytes)
             self.ckpt_boundary_bytes.append(int(nbytes))
+            self._emit(
+                "ckpt_write",
+                {"seconds": round(float(seconds), 6),
+                 "nbytes": int(nbytes)},
+            )
 
     def aggregate(self) -> Dict[str, Any]:
         """The bench-record / protocol summary."""
@@ -234,6 +285,20 @@ class ChunkPipelineStats:
             "overlap_efficiency": (
                 round(1.0 - stall / wall, 4) if wall > 0 else 1.0
             ),
+            # ISSUE 10 telemetry: the boundary-sampled HBM high-water
+            # mark (None on statless backends — CPU) and the FINAL
+            # streaming-diagnostics fetch (None when
+            # live_diagnostics is off) — the two fields bench stamps
+            # per chunked rung
+            "hbm_peak_bytes": self._last_chunk_field(
+                "hbm_peak_bytes", reduce=max
+            ),
+            "live_rhat_final": self._last_chunk_field(
+                "live_rhat_max"
+            ),
+            "live_ess_min_final": self._last_chunk_field(
+                "live_ess_min"
+            ),
             # ISSUE 7 fault-isolation accounting: policy, retry
             # ladder history, and the final dropped-subset set —
             # JSON-friendly (string subset ids) for bench/protocol
@@ -245,6 +310,17 @@ class ChunkPipelineStats:
             # warm-deployment signature ROADMAP item 3 targets
             **self.program_summary(),
         }
+
+    def _last_chunk_field(self, name: str, reduce=None):
+        """The last (or ``reduce``-d) non-None per-chunk value of an
+        optional telemetry field; None when no chunk carried it."""
+        vals = [
+            c[name] for c in self.chunks
+            if c.get(name) is not None
+        ]
+        if not vals:
+            return None
+        return reduce(vals) if reduce is not None else vals[-1]
 
     def fault_summary(self) -> Dict[str, Any]:
         """The retry-ladder history compressed for a bench record."""
@@ -268,12 +344,25 @@ class ChunkPipelineStats:
 
 
 @contextlib.contextmanager
-def phase_timer(times: PhaseTimes, name: str) -> Iterator[None]:
-    """Time a phase; remember to block_until_ready on async results."""
+def phase_timer(
+    times: PhaseTimes, name: str, log: Any = None
+) -> Iterator[None]:
+    """Time a phase; remember to block_until_ready on async results.
+
+    With ``log`` (an obs/events.RunLog, duck-typed) the phase is also
+    emitted as a named span into the fit's run log — phase_timer is
+    the one sanctioned timing site for api-level phases (SMK110), so
+    arming a run log instruments every phase with zero changes at the
+    call sites beyond threading the log through."""
     start = time.perf_counter()
+    span = log.span(name) if log is not None else None
+    if span is not None:
+        span.__enter__()
     try:
         yield
     finally:
+        if span is not None:
+            span.__exit__(None, None, None)
         times.record(name, time.perf_counter() - start)
 
 

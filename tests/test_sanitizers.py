@@ -5,7 +5,11 @@
   ledgered ones — the HostSnapshot async copies, the K+4-byte
   _chunk_stats guard fetch, and the one-time run-identity fingerprint
   — with jax's own transfer guard armed throughout (proven armed by a
-  scalar-transfer tripwire).
+  scalar-transfer tripwire). ISSUE 10 extends the contract by exactly
+  ONE tag: an obs-armed run adds the 8K-byte per-sampling-boundary
+  `streaming_stats` fetch and nothing else
+  (TestStreamingTransferContract below, multi-boundary; the in-gate
+  single-boundary twin rides tests/test_obs.py's armed fit).
 - recompile_guard regression: two same-shape-bucket
   fit_subsets_chunked calls on one model share compiled chunk
   programs (second call: ZERO XLA backend compiles — the
@@ -154,6 +158,41 @@ class TestTransferGuardStrict:
         assert led.count("a") == 2
         assert led.bytes_for("a") == 10
         assert led.bytes_for("b") == 5
+
+
+class TestStreamingTransferContract:
+    @pytest.mark.slow  # own armed model = a fresh m=16 compile set (~6 s); the single-boundary exact assertion stays in-gate via test_obs.py's armed fit
+    def test_armed_overlap_adds_only_streaming_stats(
+        self, problem, tmp_path
+    ):
+        """ISSUE 10: live_diagnostics on an overlap+checkpoint run
+        adds EXACTLY the ledgered streaming-stats fetch — one 8K-byte
+        record per sampling boundary — on top of the historical tag
+        set, across multiple boundaries."""
+        import dataclasses
+
+        from smk_tpu.obs.streaming import fetch_nbytes
+
+        cfg = dataclasses.replace(
+            CFG, n_samples=24, live_diagnostics=True
+        )
+        armed = SpatialProbitGP(cfg, weight=1)
+        part, ct, xt, key = problem
+        path = str(tmp_path / "ck.npz")
+        with transfer_guard_strict(h2d="allow") as ledger:
+            fit_subsets_chunked(
+                armed, part, ct, xt, key, chunk_iters=6,
+                checkpoint_path=path, nan_guard=True,
+            )
+        assert ledger.tags == {
+            "host_snapshot", "chunk_stats", "run_identity",
+            "streaming_stats",
+        }
+        n_samp = 2  # 24 iters, burn 12, two 6-iter sampling chunks
+        assert ledger.count("streaming_stats") == n_samp
+        assert ledger.bytes_for("streaming_stats") == (
+            n_samp * fetch_nbytes(K)
+        )
 
 
 class TestRecompileGuard:

@@ -612,6 +612,104 @@ class TestCompileCacheConfig:
         assert "SMK109" not in rules_hit(repo_file(real), path=real)
 
 
+class TestTelemetryDiscipline:
+    """SMK110 (ISSUE 10): one span source of truth — library code
+    outside smk_tpu/obs/ + utils/tracing.py neither takes its own
+    wall-clock measurements nor hand-rolls JSONL emission."""
+
+    TIMING = (
+        "import time\n"
+        "def f():\n"
+        "    t0 = time.perf_counter()\n"
+        "    return time.perf_counter() - t0\n"
+    )
+
+    def test_direct_clock_flagged_in_library_code(self):
+        assert "SMK110" in rules_hit(self.TIMING, path=MODELS_PATH)
+        assert "SMK110" in rules_hit(
+            "import time\nt = time.time()\n",
+            path="smk_tpu/parallel/fixture.py",
+        )
+
+    def test_from_import_spelling_caught(self):
+        src = (
+            "from time import perf_counter as clock\n"
+            "def f():\n"
+            "    return clock()\n"
+        )
+        assert "SMK110" in rules_hit(src, path=MODELS_PATH)
+
+    def test_sanctioned_zones_and_nontiming_calls_clean(self):
+        # the obs package and the tracing module own the clock
+        assert "SMK110" not in rules_hit(
+            self.TIMING, path="smk_tpu/obs/fixture.py"
+        )
+        assert "SMK110" not in rules_hit(
+            self.TIMING, path="smk_tpu/utils/tracing.py"
+        )
+        # scripts/tests/bench are exempt (probe self-timing is fine)
+        assert "SMK110" not in rules_hit(self.TIMING, path=SCRIPT_PATH)
+        assert "SMK110" not in rules_hit(self.TIMING, path=TESTS_PATH)
+        assert "SMK110" not in rules_hit(self.TIMING, path="bench.py")
+        # non-clock time members are not telemetry
+        clean = (
+            "import time\n"
+            "time.sleep(0.1)\n"
+            "stamp = time.strftime('%Y')\n"
+        )
+        assert "SMK110" not in rules_hit(clean, path=MODELS_PATH)
+
+    def test_jsonl_emission_flagged_bare_dumps_clean(self):
+        emit = (
+            "import json\n"
+            "def dump(f, rec):\n"
+            "    f.write(json.dumps(rec) + '\\n')\n"
+        )
+        assert "SMK110" in rules_hit(emit, path=MODELS_PATH)
+        assert "SMK110" not in rules_hit(
+            emit, path="smk_tpu/obs/fixture.py"
+        )
+        # json.dumps WITHOUT a .write() sink (manifests,
+        # fingerprints — utils/checkpoint.py's treedef encoding)
+        bare = (
+            "import json\n"
+            "def digest(obj):\n"
+            "    return json.dumps(obj).encode()\n"
+        )
+        assert "SMK110" not in rules_hit(bare, path=MODELS_PATH)
+
+    def test_suppression_honored(self):
+        src = (
+            "import time\n"
+            "# smklint: disable=SMK110 -- fixture exercising the rule\n"
+            "t0 = time.perf_counter()\n"
+        )
+        assert "SMK110" not in rules_hit(src, path=MODELS_PATH)
+
+    def test_real_recovery_clean_and_seeded_defect_caught(self):
+        """Seeded defect on the REAL module: recovery.py was
+        converted to the tracing clock (utils/tracing.monotonic);
+        pasting a raw time.time() call back in must be caught."""
+        real = "smk_tpu/parallel/recovery.py"
+        src = repo_file(real)
+        assert "SMK110" not in rules_hit(src, path=real)
+        broken = src + (
+            "\nimport time\n"
+            "def _sneaky_timer():\n"
+            "    return time.time()\n"
+        )
+        assert "SMK110" in rules_hit(broken, path=real)
+
+    def test_real_programs_and_warmup_clean(self):
+        for real in (
+            "smk_tpu/compile/programs.py",
+            "smk_tpu/compile/warmup.py",
+        ):
+            assert "SMK110" not in rules_hit(
+                repo_file(real), path=real
+            )
+
+
 class TestTreeGate:
     def test_repo_lints_clean(self):
         """The acceptance gate as a tier-1 test: zero unsuppressed

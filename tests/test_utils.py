@@ -112,3 +112,104 @@ class TestDebugNaNs:
         except RuntimeError:
             pass
         assert jax.config.jax_debug_nans == before
+
+
+class TestTracingEdges:
+    """The previously untested utils/tracing.py edges (ISSUE 10
+    satellite): device_sync's typed-key and 0-d paths, the
+    zero-chunk/zero-wall aggregate conventions, and fault_summary's
+    max-attempt merge across events."""
+
+    def test_device_sync_typed_key_and_0d(self):
+        from smk_tpu.utils.tracing import device_sync
+
+        key = jax.random.key(0)  # typed PRNG key leaf
+        scalar = jnp.asarray(1.5)  # 0-d array leaf
+        legacy = jax.random.PRNGKey(0)  # raw uint32 key array
+        # must not raise on any leaf kind, including non-array leaves
+        device_sync({"k": key, "s": scalar, "l": legacy, "x": 3})
+
+    def test_aggregate_zero_chunks_zero_wall(self):
+        from smk_tpu.utils.tracing import ChunkPipelineStats
+
+        agg = ChunkPipelineStats().aggregate()
+        assert agg["n_chunks"] == 0
+        assert agg["total_wall_s"] == 0.0
+        # zero wall: stall fraction is 0, overlap efficiency is the
+        # vacuous 1.0 (the device was never left idle), never a
+        # ZeroDivisionError
+        assert agg["host_stall_frac"] == 0.0
+        assert agg["overlap_efficiency"] == 1.0
+        # obs fields default to None when nothing was sampled
+        assert agg["hbm_peak_bytes"] is None
+        assert agg["live_rhat_final"] is None
+        assert agg["live_ess_min_final"] is None
+
+    def test_overlap_efficiency_zero_wall_with_stall(self):
+        from smk_tpu.utils.tracing import ChunkPipelineStats
+
+        ps = ChunkPipelineStats()
+        ps.record_chunk(host_stall_s=1.0, host_work_s=1.0)
+        ps.total_wall_s = 0.0  # wall never set (early abort path)
+        agg = ps.aggregate()
+        assert agg["host_stall_s"] == 1.0
+        assert agg["host_stall_frac"] == 0.0
+        assert agg["overlap_efficiency"] == 1.0
+
+    def test_fault_summary_max_attempt_merge(self):
+        from smk_tpu.utils.tracing import ChunkPipelineStats
+
+        ps = ChunkPipelineStats(fault_policy="quarantine")
+        ps.record_fault(
+            chunk=1, iteration=6, phase="sample",
+            retried=[2], dropped=[], attempts={2: 1},
+        )
+        ps.record_fault(
+            chunk=2, iteration=12, phase="sample",
+            retried=[2, 3], dropped=[], attempts={2: 3, 3: 1},
+        )
+        ps.record_fault(
+            chunk=3, iteration=18, phase="sample",
+            retried=[], dropped=[3, 2], attempts={2: 2, 3: 2},
+        )
+        fs = ps.fault_summary()
+        # per-subset attempts merge by MAX across events, never sum
+        assert fs["retry_attempts"] == {"2": 3, "3": 2}
+        assert fs["subsets_dropped"] == [2, 3]
+        assert fs["retries_total"] == 3
+        assert fs["n_events"] == 3
+
+    def test_record_program_keyed_dedup(self):
+        from smk_tpu.utils.tracing import ChunkPipelineStats
+
+        ps = ChunkPipelineStats()
+        key = ("samp", 6, 4)
+        ps.record_program(key=key, source="fresh", compile_s=1.0)
+        ps.record_program(key=key, source="l1")  # dup: first wins
+        ps.record_program(key=("burn", 6, 4), source="l1")
+        assert len(ps.programs) == 2
+        assert ps.programs[0]["source"] == "fresh"
+        assert ps.program_summary()["program_sources"] == {
+            "fresh": 1, "l1": 1,
+        }
+
+    def test_phase_timer_emits_span_to_log(self):
+        from smk_tpu.utils.tracing import PhaseTimes, phase_timer
+
+        class FakeLog:
+            def __init__(self):
+                self.opened = []
+
+            def span(self, name, **attrs):
+                import contextlib
+
+                self.opened.append(name)
+                return contextlib.nullcontext()
+
+        times, log = PhaseTimes(), FakeLog()
+        with phase_timer(times, "combine", log=log):
+            pass
+        with phase_timer(times, "combine"):
+            pass  # log-less call stays legal
+        assert log.opened == ["combine"]
+        assert times.as_dict()["combine"] >= 0.0
