@@ -75,6 +75,13 @@ live_rhat_final / live_ess_min_final / hbm_peak_bytes.
 BENCH_RUN_LOG=<dir> arms the structured JSONL run log on every rung
 (the record stamps run_log with the file path; summarize with
 `python -m smk_tpu.obs summarize <path>`). Default off.
+BENCH_WATCHDOG=1 arms the chunk watchdog on every public chunked
+rung (ISSUE 11, parallel/domains.py — per-chunk deadline from the
+observed chunk wall; a hung dispatch becomes a typed
+ChunkTimeoutError naming the implicated failure domains instead of
+eating the whole bench budget). Pure observation: draws are
+bit-identical armed vs off; each chunked rung stamps watchdog,
+domains_dropped, and the per-domain fault summary top-level.
 
 Synthetic latent surfaces use random Fourier features (an O(n)
 stationary GP approximation) so data generation never needs an n x n
@@ -495,6 +502,12 @@ def rung_config(env, *, k, n_samples, cov_model, link, n_chains=1,
         # BENCH_RUN_LOG=<dir>
         live_diagnostics=env.get("BENCH_LIVE_DIAG", "1") != "0",
         run_log_dir=env.get("BENCH_RUN_LOG") or None,
+        # chunk watchdog (ISSUE 11): BENCH_WATCHDOG=1 bounds every
+        # chunk by a deadline derived from the observed chunk wall —
+        # a hung rung dies typed (ChunkTimeoutError naming the
+        # implicated domains) instead of eating the bench budget;
+        # draws bit-identical armed vs off
+        watchdog=env.get("BENCH_WATCHDOG", "0") == "1",
         chol_block_size=int(env.get("BENCH_CHOL_BLOCK", 0)),
         # blocked-GEMM trisolves with carried panel inverses: XLA's
         # native trisolve is latency-bound at these shapes (measured
@@ -821,6 +834,13 @@ def run_rung_public(name, *, n, k, cov_model, n_samples, q=1, p=2,
         "fault_policy": cfg.fault_policy,
         "fault_retries": fault["retries_total"],
         "subsets_dropped": fault["subsets_dropped"],
+        # ISSUE 11: host-level resilience stamps — whether the chunk
+        # watchdog was armed, which whole failure domains died, and
+        # the per-domain fault breakdown (None-able: per_domain needs
+        # the executor's domain attribution)
+        "watchdog": cfg.watchdog,
+        "domains_dropped": fault.get("domains_dropped", []),
+        "fault_domains": fault.get("per_domain") or None,
         # ISSUE 8: where this rung's compiled programs came from
         # (l1/l2/l3/fresh acquisition telemetry; pipeline.compile_s
         # is the measured acquisition time, while the top-level

@@ -64,6 +64,8 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
                                 chunk.pipeline = c("sync", "overlap"),
                                 fault.policy = c("abort", "quarantine"),
                                 fault.max.retries = 2L,
+                                watchdog = FALSE,
+                                dist.init.timeout.s = 120,
                                 n.report = NULL,
                                 checkpoint.path = NULL,
                                 compile.store.dir = NULL,
@@ -128,6 +130,19 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
   # than min_surviving_frac (config.overrides, default 0.5) of the
   # n.core subsets survive. Fault-free fits are bit-identical across
   # policies; see the README's "Fault tolerance" section.
+  # watchdog: arm the chunked executor's per-chunk deadline guard
+  # (ISSUE 11, smk_tpu/parallel/domains.py) — a hung dispatch or
+  # stuck collective becomes a typed ChunkTimeoutError naming the
+  # implicated failure domains (hosts/devices) instead of an
+  # indefinite hang. Purely observational: draws are bit-identical
+  # armed vs off. dist.init.timeout.s: the per-attempt timeout of
+  # the multi-host coordinator handshake (SMKConfig
+  # dist_init_timeout_s; transient failures retry with exponential
+  # backoff, dist_init_retries via config.overrides). With
+  # fault.policy = "quarantine", a whole failure domain dying drops
+  # only its subsets — the dropped domain indices are returned as
+  # $domains.dropped and the combined posterior is built over the
+  # survivors (see the README's "Fault tolerance" section).
   # compile.store.dir: directory of the AOT program store (ISSUE 8,
   # smk_tpu/compile/). The first fit at a given shape builds its
   # compiled programs ahead of time and serializes them there; every
@@ -202,6 +217,8 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
     chunk_pipeline = chunk.pipeline,
     fault_policy = fault.policy,
     fault_max_retries = as.integer(fault.max.retries),
+    watchdog = watchdog,
+    dist_init_timeout_s = dist.init.timeout.s,
     compile_store_dir = compile.store.dir,
     run_log_dir = run.log.dir,
     priors = smk$PriorConfig(a_prior = k.prior)
@@ -252,6 +269,9 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
     # 0-based subset indices dropped under fault.policy =
     # "quarantine" (empty integer vector on a healthy run)
     subsets.dropped = as.integer(unlist(res$subsets_dropped)),
+    # 0-based FAILURE-DOMAIN indices (hosts/processes) that lost
+    # every subset (ISSUE 11; empty on a healthy run)
+    domains.dropped = as.integer(unlist(res$domains_dropped)),
     # path of the structured run log (NULL unless run.log.dir was set)
     run.log.path = res$run_log_path,
     param.names = unlist(smk$api$param_names(as.integer(q), as.integer(p)))

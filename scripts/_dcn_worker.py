@@ -11,7 +11,18 @@ quantile grid (the one collective — it crosses the process boundary),
 and print a digest for the test to compare against a single-process
 run of the same seeds.
 
-Usage: python scripts/_dcn_worker.py <process_id> <num_processes> <port>
+Usage: python scripts/_dcn_worker.py <process_id> <num_processes> <port> [mode]
+
+``mode`` (default "normal") drives the ISSUE 11 kill-the-child leg:
+
+- ``die_mid``: exit cleanly right after joining the coordination
+  service — the simulated mid-run host death. The surviving
+  coordinator's collective then has a dead peer.
+- ``guard``: run the whole sharded fit + combine under a
+  parallel/domains.ChunkWatchdog deadline; when the dead peer hangs
+  the collective, print ``DCN_TIMEOUT <json>`` (the typed
+  ChunkTimeoutError, naming the implicated process domains) instead
+  of hanging forever.
 """
 
 import json
@@ -37,6 +48,7 @@ import numpy as np
 
 def main():
     pid, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    mode = sys.argv[4] if len(sys.argv) > 4 else "normal"
 
     from smk_tpu.parallel.distributed import init_distributed
 
@@ -45,6 +57,12 @@ def main():
         num_processes=nprocs,
         process_id=pid,
     )
+
+    if mode == "die_mid":
+        # the simulated host death: this process joined the job and
+        # then vanishes before contributing to any collective
+        print("DCN_DYING " + json.dumps({"process_id": pid}), flush=True)
+        return
 
     from smk_tpu.config import SMKConfig
     from smk_tpu.data.synthetic import tiny_binary_problem
@@ -67,12 +85,68 @@ def main():
     part = random_partition(jax.random.key(1), y, x, coords, k)
 
     mesh = make_mesh()  # global: one device per process
-    res = fit_subsets_sharded(
-        model, part, coords_test, x_test, jax.random.key(2), mesh=mesh
-    )
-    # the combine is the single cross-host collective of the pipeline
-    combined = combine_quantile_grids(res.param_grid, cfg.combiner)
-    combined_w = combine_quantile_grids(res.w_grid, cfg.combiner)
+
+    def fit_and_combine():
+        res = fit_subsets_sharded(
+            model, part, coords_test, x_test, jax.random.key(2),
+            mesh=mesh,
+        )
+        # the combine is the single cross-host collective of the
+        # pipeline — with a dead peer this is where the hang lives
+        combined = combine_quantile_grids(res.param_grid, cfg.combiner)
+        combined_w = combine_quantile_grids(res.w_grid, cfg.combiner)
+        # force materialization INSIDE the guarded closure: the hang
+        # surfaces at the fetch, which must happen under the deadline
+        return res, np.asarray(combined), np.asarray(combined_w)
+
+    if mode == "guard":
+        from smk_tpu.parallel.domains import (
+            ChunkTimeoutError,
+            ChunkWatchdog,
+            FailureDomainMap,
+        )
+
+        wd = ChunkWatchdog(
+            FailureDomainMap.from_mesh(k, mesh),
+            min_deadline_s=60.0,
+        )
+        try:
+            res, combined, combined_w = wd.run(
+                fit_and_combine, chunk=0, iteration=0,
+                deadline_s=60.0,
+            )
+        except ChunkTimeoutError as e:
+            print(
+                "DCN_TIMEOUT " + json.dumps({
+                    "process_id": topo.process_id,
+                    "chunk": e.chunk,
+                    "deadline_s": e.deadline_s,
+                    "domains": e.domains,
+                    "domain_labels": e.domain_labels,
+                }),
+                flush=True,
+            )
+            return
+        except Exception as e:
+            # some transports surface the dead peer THEMSELVES with a
+            # bounded transient error (gloo's ~30 s GetKeyValue
+            # deadline on CPU) before our 60 s watchdog fires — an
+            # equally typed, equally bounded outcome. Anything
+            # non-transient is a real bug and re-raises.
+            from smk_tpu.parallel.distributed import _is_transient
+
+            if not _is_transient(e):
+                raise
+            print(
+                "DCN_PEER_ERROR " + json.dumps({
+                    "process_id": topo.process_id,
+                    "error": str(e)[:200],
+                }),
+                flush=True,
+            )
+            return
+    else:
+        res, combined, combined_w = fit_and_combine()
 
     out = {
         "process_id": topo.process_id,
@@ -80,8 +154,8 @@ def main():
         "global_devices": topo.global_device_count,
         "local_devices": topo.local_device_count,
         "param_grid_shape": list(res.param_grid.shape),
-        "combined": np.asarray(combined).tolist(),
-        "combined_w_sum": float(np.asarray(combined_w).sum()),
+        "combined": combined.tolist(),
+        "combined_w_sum": float(combined_w.sum()),
     }
     print("DCN_RESULT " + json.dumps(out), flush=True)
 

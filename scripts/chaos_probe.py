@@ -37,7 +37,18 @@ module-context-sensitive); the protocol's claims are the EQUALITIES,
 not the hash values. Runs on CPU in ~2-3 min (tiny m=16 subsets; the
 engine's logic is shape-independent).
 
+Host-level protocol (ISSUE 11) -> FAULTS_DOMAIN_r12.jsonl
+(``--domains``): the failure-domain layer on top of this substrate —
+armed-vs-off bit identity + zero-compile + exact-ledger guards for
+the watchdog/domain tracking, a stalled chunk converted into a typed
+ChunkTimeoutError naming the domain, a dead domain degrading as ONE
+quarantine unit with survivors bit-identical, the flaky-coordinator
+backoff ladder (typed success and typed exhaustion), and elastic
+resume of a domain-death checkpoint onto a REDUCED topology with
+survivor draws bit-identical.
+
 Usage: JAX_PLATFORMS=cpu python scripts/chaos_probe.py [out.jsonl]
+       JAX_PLATFORMS=cpu python scripts/chaos_probe.py --domains [out.jsonl]
 """
 
 import dataclasses
@@ -105,11 +116,12 @@ def problem():
 
 
 def run(part, ct, xt, key, *, mode="sync", policy="quarantine",
-        path=None, model=None, pstats=None, **kw):
+        path=None, model=None, pstats=None, cfg_extra=None, **kw):
     if model is None:
         model = SpatialProbitGP(
             dataclasses.replace(
-                CFG, chunk_pipeline=mode, fault_policy=policy
+                CFG, chunk_pipeline=mode, fault_policy=policy,
+                **(cfg_extra or {}),
             ),
             weight=1,
         )
@@ -119,16 +131,33 @@ def run(part, ct, xt, key, *, mode="sync", policy="quarantine",
     )
 
 
+def quiet():
+    """Enter a warnings-suppressing scope; caller owns the exit."""
+    c = warnings.catch_warnings()
+    c.__enter__()
+    warnings.simplefilter("ignore")
+    return c
+
+
+def _bools(o):
+    """Every boolean leaf in a record tree — THE exit-gate walker of
+    both protocols: every claim is phrased so True means pass, so the
+    gate is simply the conjunction (a new leg cannot silently escape
+    it by not being named in the gate expression)."""
+    if isinstance(o, bool):
+        yield o
+    elif isinstance(o, dict):
+        for v in o.values():
+            yield from _bools(v)
+    elif isinstance(o, (list, tuple)):
+        for v in o:
+            yield from _bools(v)
+
+
 def main(out_path="FAULTS_r09.jsonl"):
     records = []
     raw, part, ct, xt, key = problem()
     tmp = tempfile.mkdtemp(prefix="chaos_probe_")
-
-    def quiet():
-        c = warnings.catch_warnings()
-        c.__enter__()
-        warnings.simplefilter("ignore")
-        return c
 
     # --- 1. no-fault bit-identity pin: quarantine vs abort ----------
     ref_abort = run(part, ct, xt, key, policy="abort",
@@ -366,22 +395,8 @@ def main(out_path="FAULTS_r09.jsonl"):
 
     write_records(out_path, records)
 
-    def bools(o):
-        """Every boolean leaf in the record tree — EVERY protocol
-        claim is phrased so True means pass, so the exit gate is
-        simply their conjunction (a new leg cannot silently escape
-        the gate by not being named here)."""
-        if isinstance(o, bool):
-            yield o
-        elif isinstance(o, dict):
-            for v in o.values():
-                yield from bools(v)
-        elif isinstance(o, (list, tuple)):
-            for v in o:
-                yield from bools(v)
-
     ok = (
-        all(bools(records))
+        all(_bools(records))
         and records[1]["compiles_observed"] == 0
         and all(
             rec.get("min_surviving_frac_0.95_raises") is not None
@@ -393,5 +408,317 @@ def main(out_path="FAULTS_r09.jsonl"):
     return 0 if ok else 1
 
 
+def main_domains(out_path="FAULTS_DOMAIN_r12.jsonl"):
+    """Host-level resilience protocol (ISSUE 11) — see module
+    docstring. Exit gate: the conjunction of EVERY boolean leaf."""
+    from smk_tpu.analysis.sanitizers import transfer_guard_strict
+    from smk_tpu.parallel import distributed as dist
+    from smk_tpu.parallel.combine import DomainSurvivalError
+    from smk_tpu.parallel.domains import (
+        ChunkTimeoutError,
+        FailureDomainMap,
+    )
+    from smk_tpu.testing.faults import (
+        dead_domain,
+        flaky_coordinator,
+        stall_chunk,
+    )
+
+    records = []
+    raw, part, ct, xt, key = problem()
+    tmp = tempfile.mkdtemp(prefix="chaos_domains_")
+    dm2 = FailureDomainMap.from_n_domains(K, 2)
+    dm4 = FailureDomainMap.from_n_domains(K, 4)
+    wd_cfg = {
+        "watchdog": True,
+        "watchdog_min_deadline_s": 30.0,
+        "watchdog_margin": 10.0,
+    }
+
+    # --- 1. fault-free guards: bit identity, 0 compiles, ledger ----
+    ref = run(part, ct, xt, key)  # unarmed reference
+    model_armed = SpatialProbitGP(
+        dataclasses.replace(
+            CFG, fault_policy="quarantine", **wd_cfg
+        ),
+        weight=1,
+    )
+    armed = run(part, ct, xt, key, model=model_armed, domain_map=dm2)
+    with recompile_guard(
+        0, label="warm watchdog+domain-tracked rerun"
+    ) as g:
+        # h2d relaxed, as in tests/test_sanitizers.py: fresh init
+        # states are legitimate host constants; the D2H direction is
+        # the contract under test
+        with transfer_guard_strict(h2d="allow") as ledger:
+            rerun = run(
+                part, ct, xt, key, model=model_armed, domain_map=dm2
+            )
+    records.append({
+        "record": "armed_guards_no_fault",
+        "claim": "watchdog + failure-domain tracking armed vs off: "
+                 "draws bit-identical, zero backend compiles on a "
+                 "warm model, and the strict-transfer ledger carries "
+                 "exactly the sanctioned boundary tags (no new "
+                 "untagged D2H)",
+        "hash_unarmed": sha(ref.param_samples, ref.w_samples),
+        "hash_armed": sha(armed.param_samples, armed.w_samples),
+        "bit_identical_armed_vs_off": bool(
+            np.array_equal(np.asarray(ref.param_samples),
+                           np.asarray(armed.param_samples))
+            and np.array_equal(np.asarray(ref.w_samples),
+                               np.asarray(armed.w_samples))
+        ),
+        "warm_rerun_bit_identical": bool(np.array_equal(
+            np.asarray(armed.param_samples),
+            np.asarray(rerun.param_samples),
+        )),
+        "compiles_observed": g.compiles,
+        "ledger_tags": sorted(ledger.tags),
+        "ledger_tags_exact": bool(
+            ledger.tags == {"chunk_stats", "run_identity"}
+        ),
+    })
+
+    # --- 2. stalled chunk -> typed ChunkTimeoutError ---------------
+    c = quiet()
+    err = None
+    try:
+        # iteration 18 lands in the SECOND samp-4 chunk [16, 20):
+        # first dispatches of each (kind, length) run unguarded (the
+        # compile exclusion), so the stall targets a repeated one
+        with stall_chunk(18, max_stall_s=60.0):
+            run(
+                part, ct, xt, key, domain_map=dm2,
+                cfg_extra={
+                    "watchdog": True,
+                    "watchdog_min_deadline_s": 0.3,
+                    "watchdog_margin": 2.0,
+                },
+            )
+    except ChunkTimeoutError as e:
+        err = e
+    finally:
+        c.__exit__(None, None, None)
+    records.append({
+        "record": "watchdog_stall_timeout",
+        "claim": "an injected hung dispatch is converted into a "
+                 "typed ChunkTimeoutError naming the implicated "
+                 "failure domains, within the per-chunk deadline",
+        "raised_chunk_timeout": err is not None,
+        "names_domains": bool(
+            err is not None and err.domains
+            and err.domain_labels
+            and all(
+                lab.startswith("domain:")
+                for lab in err.domain_labels
+            )
+        ),
+        "chunk": None if err is None else err.chunk,
+        "deadline_s": None if err is None else round(err.deadline_s, 3),
+        "domains": None if err is None else err.domains,
+        "domain_labels": None if err is None else err.domain_labels,
+    })
+
+    # --- 3. dead domain -> ONE quarantine unit, degraded combine ---
+    ps = ChunkPipelineStats()
+    c = quiet()
+    try:
+        with dead_domain(dm2.subsets_of(1).tolist(), 14):
+            res = run(
+                part, ct, xt, key, domain_map=dm2, pstats=ps
+            )
+    finally:
+        c.__exit__(None, None, None)
+    dead = find_failed_subsets(res).tolist()
+    fs = ps.fault_summary()
+    surv = np.ones(K, bool)
+    surv[dead] = False
+    combined = combine_quantile_grids(
+        res.param_grid, "wasserstein_mean", survival_mask=surv,
+        min_surviving_frac=0.5,
+        domain_of_subset=dm2.domain_of_subset,
+    )
+    # the DOMAIN-granular survivor floor, demonstrated where it binds
+    # BEFORE the subset floor: an asymmetric 3+1 map losing its small
+    # domain keeps 3/4 subsets (the subset floor passes at 0.7) but
+    # only 1/2 domains (the domain floor fails) — losing half the
+    # machines is named as the host-level event it is
+    from smk_tpu.parallel.combine import apply_survival_mask
+
+    asym = FailureDomainMap(
+        domain_of_subset=(0, 0, 0, 1),
+        labels=("domain:0", "domain:1"),
+    )
+    mask_a = np.array([True, True, True, False])
+    toy = np.zeros((K, 5, 2), np.float32)
+    subset_floor_ok = True
+    try:
+        apply_survival_mask(toy, mask_a, min_surviving_frac=0.7)
+    except Exception:
+        subset_floor_ok = False
+    try:
+        apply_survival_mask(
+            toy, mask_a, min_surviving_frac=0.7,
+            domain_of_subset=asym.domain_of_subset,
+        )
+        dom_err = None
+    except DomainSurvivalError as e:
+        dom_err = str(e)[:120]
+    others = [j for j in range(K) if j not in dead]
+    records.append({
+        "record": "dead_domain_degraded",
+        "claim": "all subsets of one failure domain non-finite -> "
+                 "the quarantine engine retries/kills the DOMAIN as "
+                 "one unit (one ladder, domain attribution in every "
+                 "fault event), the run completes degraded, and the "
+                 "survivors are bit-identical to the fault-free run",
+        "domain_killed": 1,
+        "subsets_dropped": dead,
+        "domain_dropped_as_unit": bool(
+            fs.get("domains_dropped") == [1]
+            and dead == dm2.subsets_of(1).tolist()
+        ),
+        "fault_events_carry_domains": bool(
+            any(
+                ev.get("domains_retried") or ev.get("domains_dropped")
+                for ev in ps.fault_events
+            )
+        ),
+        "survivors_bit_identical_to_fault_free": bool(np.array_equal(
+            np.asarray(ref.param_samples)[others],
+            np.asarray(res.param_samples)[others],
+        )),
+        "degraded_combine_finite": bool(
+            np.isfinite(np.asarray(combined)).all()
+        ),
+        "domain_floor_binds_where_subset_floor_passes": bool(
+            subset_floor_ok and dom_err is not None
+        ),
+        "domain_survival_frac_0.7_raises": dom_err,
+        "fault": fs,
+    })
+
+    # --- 4. flaky coordinator: backoff ladder + taxonomy -----------
+    dist._reset_state_for_testing()
+    c = quiet()
+    try:
+        with flaky_coordinator(2) as ctr:
+            topo = dist.init_distributed(
+                coordinator_address="127.0.0.1:1",
+                num_processes=1, process_id=0,
+                retries=3, backoff_s=0.01,
+            )
+        ok_after_backoff = topo.num_processes >= 1
+        attempts_used = ctr["calls"]
+        # idempotent re-call with the identical topology: no-op
+        topo2 = dist.init_distributed(
+            coordinator_address="127.0.0.1:1",
+            num_processes=1, process_id=0,
+        )
+        idempotent = topo2 is topo
+        try:
+            dist.init_distributed(
+                coordinator_address="127.0.0.1:2",
+                num_processes=2, process_id=0,
+            )
+            mismatch_typed = False
+        except dist.DistributedConfigError:
+            mismatch_typed = True
+        dist._reset_state_for_testing()
+        try:
+            with flaky_coordinator(99):
+                dist.init_distributed(
+                    coordinator_address="127.0.0.1:1",
+                    num_processes=1, process_id=0,
+                    retries=2, backoff_s=0.01,
+                )
+            exhausted = None
+        except dist.CoordinatorUnavailableError as e:
+            exhausted = e
+    finally:
+        dist._reset_state_for_testing()
+        c.__exit__(None, None, None)
+    records.append({
+        "record": "flaky_coordinator_backoff",
+        "claim": "init_distributed survives transient coordinator "
+                 "failures through the exponential-backoff ladder, "
+                 "raises the typed CoordinatorUnavailableError past "
+                 "the retry budget, and double-init is an idempotent "
+                 "no-op (identical topology) or a typed config error",
+        "succeeded_after_backoff": bool(ok_after_backoff),
+        "attempts_used": attempts_used,
+        "idempotent_recall_no_op": bool(idempotent),
+        "topology_mismatch_typed_error": bool(mismatch_typed),
+        "exhaustion_typed_error": exhausted is not None,
+        "exhaustion_attempts": (
+            None if exhausted is None else exhausted.attempts
+        ),
+        "backoff_schedule_s": list(
+            dist.backoff_schedule(3, 0.01, 30.0)
+        ),
+    })
+
+    # --- 5. elastic resume on a REDUCED topology -------------------
+    pth = os.path.join(tmp, "elastic.npz")
+    c = quiet()
+    try:
+        with dead_domain(dm4.subsets_of(3).tolist(), 6):
+            partial = run(
+                part, ct, xt, key, path=pth,
+                domain_map=dm4, stop_after_chunks=4,
+            )
+    finally:
+        c.__exit__(None, None, None)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        resumed = run(
+            part, ct, xt, key, path=pth, domain_map=dm2
+        )
+    msgs = [str(w.message) for w in caught]
+    dead_r = find_failed_subsets(resumed).tolist()
+    surv_idx = [j for j in range(K) if j not in dead_r]
+    records.append({
+        "record": "elastic_resume_reduced_topology",
+        "claim": "a checkpoint carrying a domain death (4-domain "
+                 "topology) resumes on a REDUCED 2-domain topology: "
+                 "surviving subsets are re-laid onto the remaining "
+                 "hosts with draws bit-identical to the fault-free "
+                 "run, per-subset deaths persist, and the topology "
+                 "change is surfaced",
+        "killed_domain_of_4": 3,
+        "partial_stopped": partial is None,
+        "resume_completed": True,
+        "elastic_warning_surfaced": bool(
+            any("elastic resume" in m for m in msgs)
+        ),
+        "dead_subsets_persist": bool(
+            dead_r == dm4.subsets_of(3).tolist()
+        ),
+        "survivors_bit_identical_to_fault_free": bool(np.array_equal(
+            np.asarray(resumed.param_samples)[surv_idx],
+            np.asarray(ref.param_samples)[surv_idx],
+        )),
+    })
+
+    write_records(out_path, records)
+    ok = (
+        all(_bools(records))
+        and records[0]["compiles_observed"] == 0
+        # string-valued claims (captured error messages) gate on
+        # presence, like main()'s min_surviving_frac leg
+        and all(
+            rec.get("domain_survival_frac_0.7_raises") is not None
+            for rec in records
+            if "domain_survival_frac_0.7_raises" in rec
+        )
+    )
+    print(f"wrote {len(records)} records to {out_path}; ok={ok}")
+    return 0 if ok else 1
+
+
 if __name__ == "__main__":
-    sys.exit(main(*sys.argv[1:]))
+    args = sys.argv[1:]
+    if args and args[0] == "--domains":
+        sys.exit(main_domains(*args[1:]))
+    sys.exit(main(*args))

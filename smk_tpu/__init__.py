@@ -30,6 +30,7 @@ from smk_tpu.api import (
 )
 from smk_tpu.parallel.partition import random_partition, Partition
 from smk_tpu.parallel.combine import (
+    DomainSurvivalError,
     SubsetSurvivalError,
     apply_survival_mask,
     wasserstein_barycenter,
@@ -47,6 +48,11 @@ from smk_tpu.parallel.recovery import (
     find_failed_subsets,
     rerun_subsets,
 )
+from smk_tpu.parallel.domains import (
+    ChunkTimeoutError,
+    ChunkWatchdog,
+    FailureDomainMap,
+)
 from smk_tpu.utils.tracing import debug_nans
 
 __version__ = "0.1.0"
@@ -60,6 +66,10 @@ __all__ = [
     "random_partition",
     "Partition",
     "SubsetSurvivalError",
+    "DomainSurvivalError",
+    "ChunkTimeoutError",
+    "ChunkWatchdog",
+    "FailureDomainMap",
     "apply_survival_mask",
     "wasserstein_barycenter",
     "weiszfeld_median",

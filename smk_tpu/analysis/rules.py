@@ -1,4 +1,4 @@
-"""smklint rules SMK101–SMK109 — the repo's JAX invariants, each one
+"""smklint rules SMK101–SMK111 — the repo's JAX invariants, each one
 traceable to the PR that established it (see analysis/RULES.md).
 
 All rules are pure-AST (no jax import). Shared machinery:
@@ -1277,6 +1277,98 @@ class TelemetryDisciplineRule(Rule):
                         break
 
 
+# ---------------------------------------------------------------------------
+# SMK111 — unbounded waits (the hang class the chunk watchdog catches)
+# ---------------------------------------------------------------------------
+
+# Blocking methods whose ZERO-argument call waits forever by default.
+# A positional argument exempts the call — dict.get(key), ",".join(xs)
+# and sock.recv(n) carry operands, while queue.get(), thread.join(),
+# fut.result(), event.wait(), lock.acquire() and sock.accept() are the
+# unbounded spellings.
+_WAIT_METHODS = {"get", "join", "result", "wait", "acquire", "accept"}
+_TIMEOUT_KWARGS = {"timeout", "timeout_s", "deadline", "deadline_s"}
+
+
+class UnboundedWaitRule(Rule):
+    id = "SMK111"
+    name = "unbounded-wait"
+    doc = (
+        "blocking waits without a timeout in smk_tpu/ library code — "
+        "queue.get()/.join()/.result()/.wait()/.acquire()/.accept() "
+        "called with no arguments and no timeout= keyword, and "
+        "socket.create_connection without a timeout. An unbounded "
+        "wait is exactly the hang class the chunk watchdog exists to "
+        "catch (ISSUE 11): a dead peer turns it into an indefinite "
+        "stall that eats the whole job. Pass a timeout and handle "
+        "expiry, or suppress with the reason the wait is bounded by "
+        "construction"
+    )
+
+    def applies(self, module):
+        return "smk_tpu/" in module.norm_path()
+
+    @staticmethod
+    def _socket_aliases(tree):
+        """Every local name create_connection may be reached through:
+        module aliases (``import socket [as s]``) and member aliases
+        (``from socket import create_connection [as conn]``) — the
+        same from-import coverage SMK110 grew for the time clocks."""
+        mod_aliases, member_aliases = set(), set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "socket":
+                        mod_aliases.add(a.asname or "socket")
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "socket" and node.level == 0:
+                    for a in node.names:
+                        if a.name == "create_connection":
+                            member_aliases.add(a.asname or a.name)
+        return mod_aliases, member_aliases
+
+    def check(self, module, ctx):
+        sock_mods, sock_members = self._socket_aliases(module.tree)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            has_timeout_kw = any(
+                kw.arg in _TIMEOUT_KWARGS for kw in node.keywords
+            )
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _WAIT_METHODS
+                and not node.args
+                and not has_timeout_kw
+            ):
+                yield self.finding(
+                    module, node,
+                    f".{node.func.attr}() with no timeout — an "
+                    "unbounded blocking wait in library code hangs "
+                    "forever when its peer dies (the failure mode "
+                    "the chunk watchdog converts into a typed "
+                    "ChunkTimeoutError); pass timeout= and handle "
+                    "expiry, or justify why the wait is bounded by "
+                    "construction",
+                )
+            elif (
+                (
+                    len(chain) == 2
+                    and chain[0] in sock_mods
+                    and chain[1] == "create_connection"
+                )
+                or (len(chain) == 1 and chain[0] in sock_members)
+            ) and len(node.args) < 2 and not has_timeout_kw:
+                yield self.finding(
+                    module, node,
+                    "socket.create_connection without a timeout "
+                    "inherits the system default (often infinite) — "
+                    "pass an explicit timeout so a dead coordinator "
+                    "surfaces as an error, not a hang",
+                )
+
+
 ALL_RULES = [
     BatchingRuleRule(),
     HostNondeterminismRule(),
@@ -1288,4 +1380,5 @@ ALL_RULES = [
     FaultInjectionZoneRule(),
     CompileCacheConfigRule(),
     TelemetryDisciplineRule(),
+    UnboundedWaitRule(),
 ]

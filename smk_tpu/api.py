@@ -72,6 +72,11 @@ class MetaKrigingResult(NamedTuple):
         ``config.run_log_dir`` is set (ISSUE 10, smk_tpu/obs/ —
         summarize with ``python -m smk_tpu.obs summarize``); None
         when the run log is off.
+    domains_dropped : failure domains (hosts/processes —
+        parallel/domains.py, ISSUE 11) none of whose subsets
+        survived: every index here lost ALL its subsets, the
+        host-level fault signature. Empty on fault-free runs and
+        always empty under ``"abort"``.
     """
 
     param_grid: jnp.ndarray
@@ -92,6 +97,7 @@ class MetaKrigingResult(NamedTuple):
     phase_seconds: dict
     subsets_dropped: tuple = ()
     run_log_path: Optional[str] = None
+    domains_dropped: tuple = ()
 
 
 def param_names(q: int, p: int) -> list[str]:
@@ -464,15 +470,33 @@ def _fit_meta_kriging_impl(
     # prior round.
     survival_mask = None
     subsets_dropped: tuple = ()
+    domains_dropped: tuple = ()
+    domain_of_subset = None
     if cfg.fault_policy == "quarantine":
         import numpy as np
 
+        from smk_tpu.parallel.domains import FailureDomainMap
         from smk_tpu.parallel.recovery import find_failed_subsets
 
         failed = find_failed_subsets(results)
         survival_mask = np.ones(cfg.n_subsets, bool)
         survival_mask[failed] = False
         subsets_dropped = tuple(int(i) for i in failed)
+        # failure-domain attribution (ISSUE 11): the same derivation
+        # the chunked executor used, so the survivor floor is also
+        # enforced at host granularity (DomainSurvivalError when most
+        # of the machines are gone) and the dropped DOMAINS — those
+        # that lost every subset — are named in the result
+        dmap = FailureDomainMap.derive(
+            cfg.n_subsets,
+            mesh if mesh is not None
+            else (make_mesh(axis=cfg.mesh_axis) if sharded else None),
+        )
+        domain_of_subset = np.asarray(dmap.domain_of_subset, int)
+        domains_dropped = tuple(
+            int(d) for d in range(dmap.n_domains)
+            if not survival_mask[dmap.subsets_of(d)].any()
+        )
 
     with phase_timer(times, "combine", log=run_log):
         param_grid = combine_quantile_grids(
@@ -480,12 +504,14 @@ def _fit_meta_kriging_impl(
             n_iter=cfg.weiszfeld_iters, eps=cfg.weiszfeld_eps,
             survival_mask=survival_mask,
             min_surviving_frac=cfg.min_surviving_frac,
+            domain_of_subset=domain_of_subset,
         )
         w_grid = combine_quantile_grids(
             results.w_grid, cfg.combiner,
             n_iter=cfg.weiszfeld_iters, eps=cfg.weiszfeld_eps,
             survival_mask=survival_mask,
             min_surviving_frac=cfg.min_surviving_frac,
+            domain_of_subset=domain_of_subset,
         )
         device_sync((param_grid, w_grid))
 
@@ -532,4 +558,5 @@ def _fit_meta_kriging_impl(
         phase_seconds=times.as_dict(),
         subsets_dropped=subsets_dropped,
         run_log_path=run_log.path if run_log is not None else None,
+        domains_dropped=domains_dropped,
     )

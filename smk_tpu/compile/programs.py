@@ -72,6 +72,14 @@ _DIGEST_NEUTRAL = dict(
     live_diagnostics=False,
     profile_dir=None,
     profile_chunks=None,
+    # host-resilience knobs (ISSUE 11): the watchdog observes and the
+    # distributed bring-up retries — neither changes any compiled
+    # program, so a store built unguarded must serve guarded runs
+    watchdog=False,
+    watchdog_min_deadline_s=60.0,
+    watchdog_margin=10.0,
+    dist_init_timeout_s=120.0,
+    dist_init_retries=3,
 )
 
 

@@ -366,8 +366,46 @@ class SMKConfig:
     # Minimum fraction of the K subsets that must survive to combine:
     # below this, fit_meta_kriging raises
     # parallel.combine.SubsetSurvivalError instead of silently
-    # returning a posterior built from a rump of the data.
+    # returning a posterior built from a rump of the data. The SAME
+    # fraction also applies at FAILURE-DOMAIN granularity (ISSUE 11,
+    # parallel/domains.py): when fewer than this fraction of the
+    # run's domains (hosts/processes, or devices) still own a
+    # surviving subset, the fit raises
+    # parallel.combine.DomainSurvivalError — losing most of the
+    # machines is a different operational event than losing scattered
+    # subsets, and is named as such.
     min_surviving_frac: float = 0.5
+
+    # Hardened distributed bring-up (ISSUE 11,
+    # parallel/distributed.init_distributed): each coordinator
+    # handshake attempt is bounded by dist_init_timeout_s (passed
+    # through as jax's initialization_timeout where supported) and
+    # TRANSIENT failures (coordinator unreachable / barrier timeout)
+    # are retried dist_init_retries times after a deterministic
+    # exponential backoff — then CoordinatorUnavailableError; a
+    # non-transient failure raises DistributedConfigError
+    # immediately. Pure bring-up knobs: normalized out of the
+    # run-identity hash and the compile-store digest (they cannot
+    # change the chain).
+    dist_init_timeout_s: float = 120.0
+    dist_init_retries: int = 3
+
+    # Chunk watchdog (ISSUE 11, parallel/domains.ChunkWatchdog):
+    # when True, the chunked executor runs each chunk's dispatch and
+    # boundary work under a deadline of
+    # max(watchdog_min_deadline_s, watchdog_margin * estimate), where
+    # estimate is the max observed wall of recent chunks — a hung
+    # dispatch or stuck collective becomes a typed ChunkTimeoutError
+    # naming the implicated failure domains instead of an indefinite
+    # hang (the first chunk of each program runs unguarded: it
+    # legitimately pays compile). Purely observational: fault-free
+    # runs are BIT-identical armed vs off with zero extra compiles
+    # (tests/test_domains.py, FAULTS_DOMAIN_r12.jsonl), so all three
+    # knobs are normalized out of the run-identity hash and the
+    # compile digest.
+    watchdog: bool = False
+    watchdog_min_deadline_s: float = 60.0
+    watchdog_margin: float = 10.0
 
     # AOT program store (ISSUE 8; smk_tpu/compile/) — the cold-compile
     # killers for the public chunked path (ROADMAP open item 3:
@@ -522,7 +560,7 @@ class SMKConfig:
         "resample_size", "weiszfeld_iters", "phi_update_every",
         "cg_iters", "cg_precond_rank", "chol_block_size",
         "trisolve_block_size", "pg_n_terms", "phi_proposals",
-        "fault_max_retries",
+        "fault_max_retries", "dist_init_retries",
     )
 
     def __post_init__(self):
@@ -599,6 +637,21 @@ class SMKConfig:
             raise ValueError(
                 "min_surviving_frac must be in (0, 1] — 0 would "
                 "accept a posterior built from zero subsets"
+            )
+        if self.dist_init_timeout_s <= 0:
+            raise ValueError("dist_init_timeout_s must be > 0")
+        if self.dist_init_retries < 0:
+            raise ValueError("dist_init_retries must be >= 0")
+        if not isinstance(self.watchdog, bool):
+            raise ValueError(
+                f"watchdog must be a bool, got {self.watchdog!r}"
+            )
+        if self.watchdog_min_deadline_s <= 0:
+            raise ValueError("watchdog_min_deadline_s must be > 0")
+        if self.watchdog_margin < 1.0:
+            raise ValueError(
+                "watchdog_margin must be >= 1 — a deadline below the "
+                "observed chunk wall would kill healthy chunks"
             )
         for name in (
             "compile_store_dir", "xla_cache_dir", "run_log_dir",

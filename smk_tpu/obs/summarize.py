@@ -150,6 +150,7 @@ def summarize(path: str) -> Dict[str, Any]:
     )
     cov = coverage(root, children) if root is not None else None
     faults = [e["attrs"] for e in _events_named(run, "fault")]
+    watchdog = [e["attrs"] for e in _events_named(run, "watchdog")]
     programs = [e["attrs"] for e in _events_named(run, "program")]
     live = [e["attrs"] for e in _events_named(run, "live_diagnostics")]
     ckpt = [e["attrs"] for e in _events_named(run, "ckpt_write")]
@@ -185,6 +186,14 @@ def summarize(path: str) -> Dict[str, Any]:
             "bytes": sum(int(c.get("nbytes", 0)) for c in ckpt),
         },
         "faults": faults,
+        # ISSUE 11: chunk-watchdog timeline — one "armed" record when
+        # the first deadline exists, one "fired" per converted hang
+        "watchdog": {
+            "n_events": len(watchdog),
+            "fired": [
+                w for w in watchdog if w.get("action") == "fired"
+            ],
+        },
         "programs": programs,
         "live_diagnostics": {
             "n_boundaries": len(live),
@@ -258,6 +267,16 @@ def main(argv: List[str]) -> int:
         )
         if ch.get("hbm_peak_bytes") is not None:
             print(f"hbm_peak_bytes: {ch['hbm_peak_bytes']}")
+    if summary["watchdog"]["fired"]:
+        print(
+            f"\nwatchdog fired {len(summary['watchdog']['fired'])} "
+            "time(s):"
+        )
+        for w in summary["watchdog"]["fired"]:
+            print(
+                f"  chunk {w.get('chunk')} deadline "
+                f"{w.get('deadline_s')}s domains {w.get('domains')}"
+            )
     if summary["faults"]:
         print(f"\nfaults ({len(summary['faults'])}):")
         for f in summary["faults"]:

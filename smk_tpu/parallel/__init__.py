@@ -9,11 +9,17 @@ from smk_tpu.parallel.executor import (
     make_mesh,
 )
 from smk_tpu.parallel.combine import (
+    DomainSurvivalError,
     SubsetSurvivalError,
     apply_survival_mask,
     wasserstein_barycenter,
     weiszfeld_median,
     combine_quantile_grids,
+)
+from smk_tpu.parallel.domains import (
+    ChunkTimeoutError,
+    ChunkWatchdog,
+    FailureDomainMap,
 )
 from smk_tpu.parallel.recovery import (
     SubsetNaNError,
@@ -34,6 +40,10 @@ __all__ = [
     "rerun_subsets",
     "SubsetNaNError",
     "SubsetSurvivalError",
+    "DomainSurvivalError",
+    "ChunkTimeoutError",
+    "ChunkWatchdog",
+    "FailureDomainMap",
     "apply_survival_mask",
     "make_mesh",
     "wasserstein_barycenter",

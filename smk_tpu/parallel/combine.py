@@ -56,6 +56,31 @@ class SubsetSurvivalError(RuntimeError):
         )
 
 
+class DomainSurvivalError(SubsetSurvivalError):
+    """Too few FAILURE DOMAINS (hosts/processes/devices —
+    parallel/domains.py) still own a surviving subset: the degraded
+    posterior would be computed after losing most of the machines,
+    which is a different operational event than losing scattered
+    subsets and is named as such (ISSUE 11). Subclasses
+    :class:`SubsetSurvivalError` so existing handlers catch both."""
+
+    def __init__(self, n_surviving: int, n_total: int, min_frac: float):
+        self.n_surviving = int(n_surviving)
+        self.n_total = int(n_total)
+        self.min_frac = float(min_frac)
+        RuntimeError.__init__(
+            self,
+            f"only {self.n_surviving}/{self.n_total} failure domains "
+            f"still own a surviving subset but "
+            f"min_surviving_frac={min_frac} requires at least "
+            f"{max(1, int(np.ceil(min_frac * n_total)))} — most of "
+            "the run's hosts are gone; inspect the dropped domains "
+            "(result.domains_dropped, the checkpoint manifest's "
+            "fault_domain fields) or lower config.min_surviving_frac "
+            "deliberately",
+        )
+
+
 def wasserstein_barycenter(grids: jnp.ndarray) -> jnp.ndarray:
     """Mean of (K, n_q, d) quantile grids over K (R:123-133)."""
     return jnp.mean(grids, axis=0)
@@ -132,6 +157,7 @@ def apply_survival_mask(
     survival_mask,
     *,
     min_surviving_frac: float = 0.0,
+    domain_of_subset=None,
 ) -> jnp.ndarray:
     """Drop dead subsets from a (K, n_q, d) grid stack.
 
@@ -141,7 +167,15 @@ def apply_survival_mask(
     before any combiner reduction. Raises :class:`SubsetSurvivalError`
     when fewer than ``max(1, ceil(min_surviving_frac * K))`` survive.
     An all-True mask returns ``grids`` unchanged (bit-identity for
-    fault-free runs)."""
+    fault-free runs).
+
+    ``domain_of_subset`` (optional, (K,) ints — ISSUE 11,
+    parallel/domains.py) extends the survivor floor to FAILURE-DOMAIN
+    granularity: a domain survives when any of its subsets does, and
+    fewer than ``max(1, ceil(min_surviving_frac * n_domains))``
+    surviving domains raises :class:`DomainSurvivalError` — a
+    degraded combine after losing most of the machines is named as
+    the host-level event it is."""
     mask = np.asarray(survival_mask, bool).reshape(-1)
     k = int(grids.shape[0])
     if mask.shape[0] != k:
@@ -152,6 +186,21 @@ def apply_survival_mask(
     n_surv = int(mask.sum())
     if n_surv < max(1, int(np.ceil(min_surviving_frac * k))):
         raise SubsetSurvivalError(n_surv, k, min_surviving_frac)
+    if domain_of_subset is not None:
+        doms = np.asarray(domain_of_subset, int).reshape(-1)
+        if doms.shape[0] != k:
+            raise ValueError(
+                f"domain_of_subset has {doms.shape[0]} entries for "
+                f"{k} subset grids"
+            )
+        n_domains = len(set(doms.tolist()))
+        n_dom_surv = len(set(doms[mask].tolist()))
+        if n_dom_surv < max(
+            1, int(np.ceil(min_surviving_frac * n_domains))
+        ):
+            raise DomainSurvivalError(
+                n_dom_surv, n_domains, min_surviving_frac
+            )
     if mask.all():
         return grids
     return jnp.asarray(grids)[np.where(mask)[0]]
@@ -165,6 +214,7 @@ def combine_quantile_grids(
     eps: float = 1e-8,
     survival_mask: Optional[np.ndarray] = None,
     min_surviving_frac: float = 0.0,
+    domain_of_subset=None,
 ) -> jnp.ndarray:
     """Dispatch on the configured combiner.
 
@@ -172,11 +222,15 @@ def combine_quantile_grids(
     subsets are dropped from the reduction (see
     :func:`apply_survival_mask`); fails with
     :class:`SubsetSurvivalError` below ``min_surviving_frac``.
+    ``domain_of_subset`` (optional, (K,) ints) additionally enforces
+    the floor at failure-domain granularity
+    (:class:`DomainSurvivalError`).
     """
     if survival_mask is not None:
         grids = apply_survival_mask(
             grids, survival_mask,
             min_surviving_frac=min_surviving_frac,
+            domain_of_subset=domain_of_subset,
         )
     if method == "wasserstein_mean":
         return wasserstein_barycenter(grids)

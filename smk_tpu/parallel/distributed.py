@@ -1,5 +1,5 @@
 """Multi-host (DCN) initialization — the executable form of the
-SURVEY.md §5.8 scaling story.
+SURVEY.md §5.8 scaling story, hardened for ISSUE 11.
 
 The reference's only "distributed backend" is localhost PSOCK sockets
 (MetaKriging_BinaryResponse.R:102-108). The TPU framework's story is:
@@ -12,17 +12,24 @@ the one collective (the combiner's quantile-grid reduction) over ICI
 within a slice and DCN across slices; per-iteration DCN traffic is
 zero.
 
-This module makes that story runnable rather than prose
-(round-3 VERDICT: "the DCN path is prose, not code"):
+Hardening (ISSUE 11 — a 256-subset job must not die to a transient
+coordinator hiccup or hang forever on one):
 
-- :func:`init_distributed` wraps ``jax.distributed.initialize`` with
-  the framework's conventions and returns the process topology.
-- ``tests/test_distributed.py`` actually launches two coordinated CPU
-  processes (JAX's documented multi-process-on-CPU mode), builds the
-  global 2-device mesh, runs ``fit_subsets_sharded`` across the two
-  processes, and checks the gathered grids against a single-process
-  run of the same seed — the strongest multi-host validation a
-  single machine can host.
+- the coordinator handshake runs under a configurable timeout
+  (``SMKConfig.dist_init_timeout_s``) with deterministic
+  exponential-backoff retries on TRANSIENT failures
+  (``SMKConfig.dist_init_retries``; :func:`backoff_schedule`);
+- a typed error taxonomy: :class:`CoordinatorUnavailableError` when
+  the retry budget is exhausted on transient failures,
+  :class:`DistributedConfigError` for non-transient
+  (configuration/topology) failures and for double initialization
+  with a different topology;
+- an explicit idempotence guard: ``init_distributed`` is documented
+  "call once per process" — a re-call with the IDENTICAL topology is
+  now a no-op fast path returning the established
+  :class:`ProcessTopology`, and a re-call with a different one raises
+  :class:`DistributedConfigError` with an actionable message instead
+  of surfacing whatever jax raises.
 
 On a real multi-host TPU pod the same calls apply verbatim; the
 coordinator address comes from the cluster environment (GKE/Borg set
@@ -33,9 +40,86 @@ arguments defers entirely to JAX's auto-detection).
 from __future__ import annotations
 
 import dataclasses
+import inspect
+import time
+import warnings
 from typing import Optional
 
 import jax
+
+
+class DistributedInitError(RuntimeError):
+    """Base of the ``init_distributed`` error taxonomy."""
+
+
+class CoordinatorUnavailableError(DistributedInitError):
+    """Every attempt at the coordinator handshake failed with a
+    TRANSIENT error (timeout / unreachable / barrier) and the retry
+    budget is exhausted. Carries the attempt count and the last
+    underlying error."""
+
+    def __init__(self, attempts: int, timeout_s: float, last: BaseException):
+        self.attempts = int(attempts)
+        self.timeout_s = float(timeout_s)
+        self.last_error = last
+        super().__init__(
+            f"jax.distributed.initialize failed {self.attempts} "
+            f"time(s) with transient coordinator errors (timeout "
+            f"{self.timeout_s:.0f}s per attempt; last: {last!r}) — "
+            "the coordinator is unreachable or still starting. Check "
+            "the coordinator address/port and that process 0 is up, "
+            "or raise SMKConfig.dist_init_retries / "
+            "dist_init_timeout_s for slow cluster bring-up"
+        )
+
+
+class DistributedConfigError(DistributedInitError):
+    """Non-transient initialization failure: bad topology arguments,
+    or a second ``init_distributed`` call with a DIFFERENT topology
+    in a process that already initialized one."""
+
+
+# Substrings of the transient (retryable) coordinator failure class —
+# the coordination service surfaces gRPC-style statuses in messages.
+_TRANSIENT_MARKERS = (
+    "deadline",
+    "timed out",
+    "timeout",
+    "unavailable",
+    "connection refused",
+    "failed to connect",
+    "connection reset",
+    "barrier",
+    "temporarily",
+)
+
+
+def _is_transient(exc: BaseException) -> bool:
+    """Retryable? Connection/timeout exception types, or a message
+    carrying one of the known transient markers."""
+    if isinstance(exc, (ConnectionError, TimeoutError)):
+        return True
+    msg = str(exc).lower()
+    return any(m in msg for m in _TRANSIENT_MARKERS)
+
+
+def backoff_schedule(
+    retries: int, base_s: float = 1.0, cap_s: float = 30.0
+) -> tuple:
+    """Deterministic exponential backoff: the sleep before each of the
+    ``retries`` re-attempts — ``min(cap_s, base_s * 2**i)``. No
+    jitter: library randomness comes from the carried PRNG key only
+    (smklint SMK102), and all SMK processes of one job backing off in
+    lockstep is FINE here — they are waiting on one coordinator, not
+    contending for a lock."""
+    if retries < 0:
+        raise ValueError(f"retries must be >= 0, got {retries}")
+    if base_s <= 0 or cap_s <= 0:
+        raise ValueError("base_s and cap_s must be > 0")
+    return tuple(
+        min(float(cap_s), float(base_s) * (2.0 ** i))
+        for i in range(int(retries))
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -52,11 +136,45 @@ class ProcessTopology:
         return self.process_id == 0
 
 
+# The one-per-process initialization state: (topology, normalized
+# argument key). jax.distributed supports exactly one initialization
+# per process; this module-level guard is what turns a violation into
+# a clear typed error (or a no-op) instead of a backend crash.
+_ACTIVE: Optional[tuple] = None
+
+
+def _reset_state_for_testing() -> None:
+    """Forget the idempotence-guard state (the underlying jax
+    distributed client, if any, is NOT shut down — tests pair this
+    with a patched ``jax.distributed.initialize``)."""
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def _accepts_kwarg(fn, name: str) -> bool:
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):  # pragma: no cover - builtins
+        return False
+    if name in params:
+        return True
+    return any(
+        p.kind is inspect.Parameter.VAR_KEYWORD
+        for p in params.values()
+    )
+
+
 def init_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
     local_device_ids: Optional[list] = None,
+    *,
+    timeout_s: Optional[float] = None,
+    retries: Optional[int] = None,
+    backoff_s: float = 1.0,
+    backoff_cap_s: float = 30.0,
+    config=None,
 ) -> ProcessTopology:
     """Join (or auto-detect) a multi-process JAX job.
 
@@ -65,14 +183,73 @@ def init_distributed(
     with explicit arguments, wires an ad-hoc job — e.g. two CPU
     processes on one machine (the test) or hand-launched hosts.
 
+    ``timeout_s`` bounds each handshake attempt (passed through as
+    jax's ``initialization_timeout`` where the installed jax supports
+    it); ``retries`` transient failures are retried after a
+    deterministic exponential backoff (:func:`backoff_schedule`).
+    Defaults come from ``config`` (an :class:`~smk_tpu.config
+    .SMKConfig` — fields ``dist_init_timeout_s`` /
+    ``dist_init_retries``) or fall back to 120 s / 3. Non-transient
+    failures raise :class:`DistributedConfigError` immediately;
+    exhausted retries raise :class:`CoordinatorUnavailableError`.
+
+    Call once per process, before any other JAX API touches the
+    backend. A second call with the IDENTICAL topology is a warned
+    no-op returning the established :class:`ProcessTopology`; a
+    second call with a different topology raises
+    :class:`DistributedConfigError` (one process = one topology; to
+    change it, restart the process).
+
     After this returns, ``jax.devices()`` enumerates every chip in
     the job, ``executor.make_mesh()`` therefore spans hosts, and
     ``fit_subsets_sharded`` / ``fit_subsets_chunked(mesh=...)`` run
     globally with zero per-iteration cross-host traffic (the subset
     axis is embarrassingly parallel; only the final grid combine
-    crosses DCN). Idempotent-unfriendly: call once per process, before
-    any other JAX API touches the backend.
+    crosses DCN).
     """
+    global _ACTIVE
+    if timeout_s is None:
+        timeout_s = (
+            float(config.dist_init_timeout_s)
+            if config is not None else 120.0
+        )
+    if retries is None:
+        retries = (
+            int(config.dist_init_retries)
+            if config is not None else 3
+        )
+    if timeout_s <= 0:
+        raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+    arg_key = (
+        coordinator_address,
+        num_processes,
+        process_id,
+        tuple(local_device_ids) if local_device_ids is not None else None,
+    )
+    if _ACTIVE is not None:
+        topo, prev_key = _ACTIVE
+        if arg_key == prev_key:
+            # idempotent fast path: same topology, nothing to do —
+            # the double call is usually a library composing with
+            # user code that already initialized
+            warnings.warn(
+                "init_distributed called again with the identical "
+                "topology; returning the established ProcessTopology "
+                "(jax.distributed supports one initialization per "
+                "process)",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            return topo
+        raise DistributedConfigError(
+            "init_distributed was already called in this process "
+            f"with topology {prev_key} (established: {topo}); the "
+            f"new call requests {arg_key}. jax.distributed supports "
+            "exactly one initialization per process — to change the "
+            "topology, restart the process (elastic resume onto a "
+            "smaller topology is a NEW process joining a NEW job; "
+            "see README 'Fault tolerance')"
+        )
     kwargs = {}
     if coordinator_address is not None:
         kwargs["coordinator_address"] = coordinator_address
@@ -94,10 +271,52 @@ def init_distributed(
         # non-CPU backend wins resolution, since only the CPU client
         # reads this config.
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    jax.distributed.initialize(**kwargs)
-    return ProcessTopology(
+    # the signature probe runs per call (not at import) so the chaos
+    # harness's flaky_coordinator patch is seen, and so a jax without
+    # initialization_timeout simply doesn't receive it
+    if _accepts_kwarg(jax.distributed.initialize, "initialization_timeout"):
+        # jax takes whole seconds; round UP so a sub-second request
+        # never truncates to 0 (= backend default / instant failure)
+        kwargs["initialization_timeout"] = max(
+            1, -(-int(timeout_s * 1000) // 1000)
+        )
+    schedule = backoff_schedule(retries, backoff_s, backoff_cap_s)
+    attempt = 0
+    while True:
+        try:
+            jax.distributed.initialize(**kwargs)
+            break
+        except DistributedInitError:
+            raise
+        except Exception as e:
+            if not _is_transient(e):
+                raise DistributedConfigError(
+                    "jax.distributed.initialize failed with a "
+                    f"non-transient error: {e!r} — check the "
+                    "topology arguments (coordinator_address/"
+                    "num_processes/process_id) and the cluster "
+                    "environment; transient coordinator failures "
+                    "would have been retried"
+                ) from e
+            if attempt >= retries:
+                raise CoordinatorUnavailableError(
+                    attempt + 1, timeout_s, e
+                ) from e
+            delay = schedule[attempt]
+            warnings.warn(
+                f"jax.distributed.initialize attempt "
+                f"{attempt + 1}/{retries + 1} failed transiently "
+                f"({e!r}); retrying in {delay:.1f}s",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            time.sleep(delay)
+            attempt += 1
+    topo = ProcessTopology(
         process_id=jax.process_index(),
         num_processes=jax.process_count(),
         local_device_count=jax.local_device_count(),
         global_device_count=jax.device_count(),
     )
+    _ACTIVE = (topo, arg_key)
+    return topo

@@ -1,0 +1,395 @@
+"""Failure-domain topology + chunk watchdog (ISSUE 11).
+
+SMK's share-nothing property (K independent subset posteriors,
+combined once) means a multi-host run should *degrade*, never abort,
+when one chip or host goes sick. PR 7's quarantine engine isolates
+per-subset numerical faults inside a healthy process; this module
+adds the two host-level pieces it lacked:
+
+- :class:`FailureDomainMap` — the subset index → device →
+  process/host attribution. Every fault, retry and death in the
+  quarantine engine (parallel/recovery.py) is attributed to a domain,
+  and a WHOLE-domain fault (every live subset of a domain non-finite
+  at one boundary — the signature of a dead chip/host rather than a
+  sick chain) is handled as ONE event on ONE retry ladder, not
+  K/num_hosts independent subset ladders.
+- :class:`ChunkWatchdog` — a per-chunk deadline derived from a moving
+  estimate of the observed chunk wall. The guarded chunk work runs on
+  a watchdog worker thread while the calling thread waits with the
+  deadline, so a hung dispatch or a stuck collective becomes a typed
+  :class:`ChunkTimeoutError` carrying the implicated domains instead
+  of an indefinite hang that eats the whole job. The watchdog
+  observes and times; it never touches the chain — fault-free runs
+  are bit-identical armed vs off (the dispatched programs and their
+  order are unchanged; matmul-precision scoping lives inside the
+  model's trace, so the worker thread is trace-neutral).
+
+Elastic degraded runs: the domain map is metadata over the subset
+axis — each subset's chain depends only on its (data slice, PRNG key)
+— so a checkpoint written under one topology resumes legally under a
+*smaller* one (the map is re-derived, surviving subsets are re-laid
+onto the remaining hosts) with survivor draws bit-identical; see the
+manifest's domain-attribution fields in parallel/recovery.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+import numpy as np
+
+from smk_tpu.utils.tracing import monotonic
+
+# Moving-estimate window: the deadline tracks the MAX observed wall of
+# the most recent chunks (max, not median — dispatch-side and
+# boundary-side sections of one chunk cycle have very different walls,
+# and the deadline must cover the slowest legitimate one).
+_ESTIMATE_WINDOW = 32
+
+
+class ChunkTimeoutError(RuntimeError):
+    """A guarded chunk section exceeded its watchdog deadline — a hung
+    dispatch, a stuck device program, or a collective waiting on an
+    unreachable peer. Carries the chunk index, the global iteration,
+    the deadline that fired, and the failure domains IN FLIGHT at the
+    timeout. A whole-K dispatch spans every domain, so ``domains`` is
+    the candidate set, not a localization — the watchdog can see THAT
+    the chunk hung, not which peer hung it; narrow the suspect on
+    resume, where the quarantine engine's per-domain fault
+    attribution (manifest ``fault_domain*`` fields, fault-event
+    ``domains_*`` lists) identifies the domain whose subsets actually
+    go non-finite."""
+
+    def __init__(self, chunk, iteration, deadline_s, domains, labels):
+        self.chunk = int(chunk)
+        self.iteration = int(iteration)
+        self.deadline_s = float(deadline_s)
+        self.domains = [int(d) for d in domains]
+        self.domain_labels = [str(lab) for lab in labels]
+        named = ", ".join(
+            f"{d} ({lab})"
+            for d, lab in zip(self.domains, self.domain_labels)
+        )
+        super().__init__(
+            f"chunk {self.chunk} (iteration {self.iteration}) "
+            f"exceeded its watchdog deadline of "
+            f"{self.deadline_s:.1f}s — failure domains in flight: "
+            f"[{named}]. The dispatch or its boundary fetch is hung "
+            "(dead host, stuck collective, or wedged device queue); "
+            "the last checkpoint (if any) precedes this chunk — "
+            "resume from it, on a reduced topology if a host is gone "
+            "(fault_policy='quarantine' re-lays surviving subsets "
+            "and its per-domain fault attribution then narrows the "
+            "suspect)"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class FailureDomainMap:
+    """Subset → failure-domain attribution.
+
+    ``domain_of_subset[i]`` is the domain (process/host, or device
+    under ``granularity="device"``) subset ``i``'s chain executes on;
+    ``labels[d]`` names domain ``d`` for reports and errors. The map
+    is pure host-side metadata: it never enters a compiled program,
+    the run-identity hash, or the compile-store digest — which is
+    exactly what makes elastic resume onto a different topology legal.
+    """
+
+    domain_of_subset: tuple
+    labels: tuple
+
+    def __post_init__(self):
+        n = len(self.labels)
+        if n < 1:
+            raise ValueError("FailureDomainMap needs >= 1 domain")
+        for i, d in enumerate(self.domain_of_subset):
+            if not 0 <= int(d) < n:
+                raise ValueError(
+                    f"subset {i} maps to domain {d}, outside "
+                    f"[0, {n})"
+                )
+        if set(range(n)) - {int(d) for d in self.domain_of_subset}:
+            raise ValueError(
+                "every domain label must own at least one subset"
+            )
+
+    @property
+    def k(self) -> int:
+        return len(self.domain_of_subset)
+
+    @property
+    def n_domains(self) -> int:
+        return len(self.labels)
+
+    def subsets_of(self, domain: int) -> np.ndarray:
+        arr = np.asarray(self.domain_of_subset)
+        return np.where(arr == int(domain))[0]
+
+    def domains_of(self, subset_ids) -> list:
+        return sorted(
+            {int(self.domain_of_subset[int(j)]) for j in subset_ids}
+        )
+
+    def whole_domain_faults(self, bad, dead) -> list:
+        """Domains suffering a WHOLE-domain fault at this boundary:
+        every not-yet-dead subset of the domain is in ``bad`` (and at
+        least one such live subset exists). ``bad``/``dead`` are (K,)
+        boolean vectors; ``bad`` must already exclude dead subsets
+        (the quarantine engine's convention)."""
+        bad = np.asarray(bad, bool)
+        dead = np.asarray(dead, bool)
+        out = []
+        for d in range(self.n_domains):
+            idx = self.subsets_of(d)
+            live = idx[~dead[idx]]
+            if live.size and bad[live].all():
+                out.append(d)
+        return out
+
+    def summary(self) -> dict:
+        """JSON-friendly description for records/manifests."""
+        return {
+            "n_domains": self.n_domains,
+            "n_subsets": self.k,
+            "labels": list(self.labels),
+            "subsets_per_domain": {
+                str(d): self.subsets_of(d).tolist()
+                for d in range(self.n_domains)
+            },
+        }
+
+    # ---- constructors -------------------------------------------------
+
+    @classmethod
+    def single_host(cls, k: int) -> "FailureDomainMap":
+        """The degenerate one-domain map (a single-process run with no
+        mesh): host-level isolation has nothing to isolate, and the
+        quarantine engine keeps PR 7's per-subset semantics exactly."""
+        return cls(
+            domain_of_subset=tuple([0] * int(k)),
+            labels=("process:0",),
+        )
+
+    @classmethod
+    def from_n_domains(
+        cls, k: int, n_domains: int, prefix: str = "domain"
+    ) -> "FailureDomainMap":
+        """Contiguous equal-block split of the K axis over
+        ``n_domains`` — the explicit-topology constructor (tests,
+        probes, and the elastic-resume re-layout all build maps this
+        way). K need not divide evenly; leading domains take the
+        remainder."""
+        k, n_domains = int(k), int(n_domains)
+        if not 1 <= n_domains <= k:
+            raise ValueError(
+                f"n_domains must be in [1, K={k}], got {n_domains}"
+            )
+        base, rem = divmod(k, n_domains)
+        doms = []
+        for d in range(n_domains):
+            doms.extend([d] * (base + (1 if d < rem else 0)))
+        return cls(
+            domain_of_subset=tuple(doms),
+            labels=tuple(f"{prefix}:{d}" for d in range(n_domains)),
+        )
+
+    @classmethod
+    def from_mesh(
+        cls, k: int, mesh, granularity: str = "process"
+    ) -> "FailureDomainMap":
+        """Derive the map from a device mesh: subset ``i`` lives on
+        device ``i // (K / mesh.size)`` (the contiguous layout the
+        sharded executor's ``NamedSharding(P(axis))`` produces), and
+        the device's ``process_index`` is its host. ``granularity``
+        selects the domain unit: ``"process"`` (default — the
+        host-level blast radius of a pod) or ``"device"`` (one domain
+        per chip — the single-host multi-chip case, where a sick chip
+        is the failure unit)."""
+        from smk_tpu.parallel.executor import subset_device_assignment
+
+        devices = subset_device_assignment(k, mesh)
+        if granularity == "device":
+            ids = [int(getattr(d, "id", i)) for i, d in enumerate(devices)]
+            order = sorted(set(ids))
+            remap = {dev: i for i, dev in enumerate(order)}
+            return cls(
+                domain_of_subset=tuple(remap[i] for i in ids),
+                labels=tuple(f"device:{dev}" for dev in order),
+            )
+        if granularity != "process":
+            raise ValueError(
+                "granularity must be 'process' or 'device', got "
+                f"{granularity!r}"
+            )
+        procs = [int(getattr(d, "process_index", 0)) for d in devices]
+        order = sorted(set(procs))
+        remap = {p: i for i, p in enumerate(order)}
+        return cls(
+            domain_of_subset=tuple(remap[p] for p in procs),
+            labels=tuple(f"process:{p}" for p in order),
+        )
+
+    @classmethod
+    def derive(cls, k: int, mesh=None) -> "FailureDomainMap":
+        """The executor's default derivation: a multi-process mesh
+        yields the process-granular map (host = blast radius of a
+        pod); a SINGLE-process mesh over several chips falls back to
+        device granularity — there the chip IS the failure unit, and
+        a process-granular map would collapse to one domain and
+        silently disable the whole-domain machinery on exactly the
+        sick-chip topology it exists for. Without a mesh, one domain
+        per process of the (possibly multi-process) job — a plain
+        single-process run is the one-domain degenerate map."""
+        if mesh is not None:
+            m = cls.from_mesh(k, mesh, granularity="process")
+            if m.n_domains == 1 and int(mesh.devices.size) > 1:
+                return cls.from_mesh(k, mesh, granularity="device")
+            return m
+        import jax
+
+        n_proc = int(jax.process_count())
+        if n_proc <= 1:
+            return cls.single_host(k)
+        return cls.from_n_domains(
+            k, min(n_proc, int(k)), prefix="process"
+        )
+
+
+class ChunkWatchdog:
+    """Deadline guard over the chunked executor's per-chunk work.
+
+    ``run(fn, chunk=..., iteration=...)`` executes ``fn`` on a fresh
+    watchdog worker thread and waits ``deadline_s``; a section that
+    overruns raises :class:`ChunkTimeoutError` on the calling thread
+    (the stuck worker is abandoned — it is a daemon thread, and the
+    process is unwinding toward resume-on-a-smaller-topology anyway).
+    The deadline is ``max(min_deadline_s, margin * estimate)`` where
+    ``estimate`` is the MAX observed wall of the last
+    ``_ESTIMATE_WINDOW`` guarded sections; until a first observation
+    exists the section runs unguarded-but-observed (seeding the
+    estimate). The chunked executor additionally bypasses the
+    watchdog ENTIRELY — no guard, no observation — for the first
+    dispatch of each (kind, length) program (parallel/recovery.py
+    ``_guarded(novel=True)``): those sections legitimately pay
+    trace/compile, which must neither trip a deadline nor inflate
+    the estimate every later deadline derives from.
+
+    Purely observational: the guarded ``fn`` performs the exact same
+    dispatches in the same order, worker exceptions (including the
+    quarantine engine's internal rewind control flow) propagate
+    unchanged, and the sanctioned-transfer ledger
+    (analysis/sanitizers.py) is process-global, so explicit_d2h tags
+    recorded from the worker thread land in the same ledger.
+    """
+
+    def __init__(
+        self,
+        domain_map: FailureDomainMap,
+        *,
+        min_deadline_s: float = 60.0,
+        margin: float = 10.0,
+        run_log=None,
+    ):
+        if min_deadline_s <= 0:
+            raise ValueError("min_deadline_s must be > 0")
+        if margin < 1.0:
+            raise ValueError(
+                "margin must be >= 1 (a deadline below the observed "
+                "wall would kill healthy chunks)"
+            )
+        self.domain_map = domain_map
+        self.min_deadline_s = float(min_deadline_s)
+        self.margin = float(margin)
+        self.run_log = run_log
+        self.fired = 0
+        self._walls: list = []
+        self._armed_logged = False
+
+    # ---- deadline math (unit-tested in tests/test_domains.py) -----
+
+    def observe(self, wall_s: float) -> None:
+        self._walls.append(float(wall_s))
+        if len(self._walls) > _ESTIMATE_WINDOW:
+            del self._walls[: -_ESTIMATE_WINDOW]
+
+    @property
+    def estimate_s(self) -> Optional[float]:
+        return max(self._walls) if self._walls else None
+
+    @property
+    def deadline_s(self) -> Optional[float]:
+        """None until a first wall is observed (unguarded warm-up)."""
+        est = self.estimate_s
+        if est is None:
+            return None
+        return max(self.min_deadline_s, self.margin * est)
+
+    def _event(self, **attrs) -> None:
+        if self.run_log is None:
+            return
+        try:
+            self.run_log.event("watchdog", **attrs)
+        except Exception:  # pragma: no cover - defensive
+            self.run_log = None
+
+    # ---- guarded execution ----------------------------------------
+
+    def run(
+        self, fn, *, chunk: int = -1, iteration: int = -1,
+        deadline_s: Optional[float] = None,
+    ):
+        """Execute ``fn()`` under the current deadline (or an explicit
+        ``deadline_s`` override); returns its result, re-raises its
+        exception, or raises :class:`ChunkTimeoutError` on overrun."""
+        deadline = (
+            float(deadline_s) if deadline_s is not None
+            else self.deadline_s
+        )
+        if deadline is None:
+            t0 = monotonic()
+            out = fn()
+            self.observe(monotonic() - t0)
+            return out
+        if not self._armed_logged:
+            self._armed_logged = True
+            self._event(
+                action="armed", chunk=int(chunk),
+                deadline_s=round(deadline, 3),
+                n_domains=self.domain_map.n_domains,
+            )
+        box = {}
+        done = threading.Event()
+
+        def worker():
+            t0 = monotonic()
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # re-raised on the caller
+                box["exc"] = e
+            finally:
+                box["wall"] = monotonic() - t0
+                done.set()
+
+        t = threading.Thread(
+            target=worker, name="smk-chunk-watchdog", daemon=True
+        )
+        t.start()
+        if not done.wait(timeout=deadline):
+            self.fired += 1
+            domains = list(range(self.domain_map.n_domains))
+            self._event(
+                action="fired", chunk=int(chunk),
+                iteration=int(iteration),
+                deadline_s=round(deadline, 3), domains=domains,
+            )
+            raise ChunkTimeoutError(
+                chunk, iteration, deadline, domains,
+                [self.domain_map.labels[d] for d in domains],
+            )
+        self.observe(box["wall"])
+        if "exc" in box:
+            raise box["exc"]
+        return box["result"]

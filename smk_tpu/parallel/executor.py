@@ -333,6 +333,23 @@ def count_subset_factorizations(
     return state.phi_accept, counts
 
 
+def subset_device_assignment(k: int, mesh: Mesh) -> list:
+    """Device of each of the ``k`` subsets under the contiguous
+    1-D layout every sharded path here uses (``NamedSharding(P(axis))``
+    over the leading K axis: subset ``i`` lives on mesh device
+    ``i // (k / n_devices)``). This is the one place that layout
+    knowledge lives — the failure-domain attribution
+    (parallel/domains.py) derives subset → device → process/host from
+    it, so a layout change cannot silently desynchronize fault
+    attribution from the actual placement."""
+    devs = list(mesh.devices.flat)
+    n_dev = len(devs)
+    if k % n_dev != 0:
+        raise ValueError(f"K={k} must be divisible by mesh size {n_dev}")
+    per = k // n_dev
+    return [devs[i // per] for i in range(k)]
+
+
 def make_mesh(n_devices: Optional[int] = None, axis: str = "subsets") -> Mesh:
     """1-D device mesh over the subset axis (ICI on a real slice)."""
     devs = jax.devices()

@@ -34,6 +34,7 @@ import numpy as np
 
 from smk_tpu.analysis.sanitizers import explicit_d2h
 from smk_tpu.compile import programs as compile_programs
+from smk_tpu.parallel.domains import ChunkWatchdog, FailureDomainMap
 from smk_tpu.models.probit_gp import (
     SpatialGPSampler,
     SubsetData,
@@ -78,10 +79,17 @@ from smk_tpu.utils.tracing import ChunkPipelineStats, monotonic
 # (fault_attempts / fault_dead), so resume under
 # fault_policy="quarantine" can skip a corrupt/truncated segment and
 # re-sample its iteration range instead of crashing, and a resumed
-# run remembers which subsets are already dead. A bump invalidates
-# older files with a clear error instead of a generic structure
-# mismatch.
-CKPT_VERSION = 6
+# run remembers which subsets are already dead; v7 the failure-domain
+# attribution (ISSUE 11, parallel/domains.py) — fault_domain (the
+# (K,) subset → domain map the writing run attributed faults under),
+# fault_domain_attempts and fault_domain_dead (the per-DOMAIN retry
+# ladders), so a resumed run neither re-grants a dead host its
+# budget nor loses which domains died; resume under a DIFFERENT
+# topology (elastic resume — fewer hosts) re-derives the attribution
+# and resets the domain ladders while per-subset deaths persist. A
+# bump invalidates older files with a clear error instead of a
+# generic structure mismatch.
+CKPT_VERSION = 7
 
 
 class ProgressAbort(Exception):
@@ -372,6 +380,15 @@ def _run_identity(cfg, key, data, beta_init) -> np.ndarray:
         live_diagnostics=False,
         profile_dir=None,
         profile_chunks=None,
+        # host-resilience knobs (ISSUE 11): the watchdog only watches
+        # and the distributed bring-up only retries — a run
+        # checkpointed guarded must resume unguarded (and on a
+        # different topology) and vice versa
+        watchdog=False,
+        watchdog_min_deadline_s=60.0,
+        watchdog_margin=10.0,
+        dist_init_timeout_s=120.0,
+        dist_init_retries=3,
     )
     crcs = [zlib.crc32(repr(cfg_ident).encode())]
     crcs.append(zlib.crc32(_key_bytes(key)))
@@ -662,7 +679,10 @@ class _SegmentedCheckpoint:
         writer: Optional[BackgroundWriter] = None,
         pstats: Optional[ChunkPipelineStats] = None,
         full_draws=None,  # callable filled -> (param_np, w_np)
-        fault_src=None,  # callable -> (attempts_np, dead_np) copies
+        # callable -> (attempts, dead, domain_map, domain_attempts,
+        # domain_dead) numpy copies — the v7 fault bookkeeping the
+        # manifest persists (ISSUE 11)
+        fault_src=None,
     ):
         self.path = path
         self.meta = meta
@@ -673,7 +693,11 @@ class _SegmentedCheckpoint:
         self._full_draws = full_draws
         k = int(meta[2])
         self._fault_src = fault_src or (
-            lambda: (np.zeros(k, np.int64), np.zeros(k, np.int64))
+            lambda: (
+                np.zeros(k, np.int64), np.zeros(k, np.int64),
+                np.zeros(k, np.int64), np.zeros(1, np.int64),
+                np.zeros(1, np.int64),
+            )
         )
         # counters below are touched only by whichever thread executes
         # the writes (strictly ordered: the writer thread in overlap
@@ -690,7 +714,7 @@ class _SegmentedCheckpoint:
     def _write_manifest(self, state_np, it: int, fault=None) -> int:
         if fault is None:
             fault = self._fault_src()
-        attempts, dead = fault
+        attempts, dead, dom_map, dom_attempts, dom_dead = fault
         return save_pytree(
             self.path,
             {
@@ -708,6 +732,18 @@ class _SegmentedCheckpoint:
                 # budget nor re-flags it every boundary
                 "fault_attempts": np.asarray(attempts, np.int64),
                 "fault_dead": np.asarray(dead, np.int64),
+                # v7 failure-domain attribution (ISSUE 11): the
+                # (K,) subset → domain map faults were attributed
+                # under, plus the per-DOMAIN retry ladders — a
+                # same-topology resume adopts them; a
+                # different-topology (elastic) resume re-derives the
+                # map and resets the ladders (per-subset deaths
+                # above persist either way)
+                "fault_domain": np.asarray(dom_map, np.int64),
+                "fault_domain_attempts": np.asarray(
+                    dom_attempts, np.int64
+                ),
+                "fault_domain_dead": np.asarray(dom_dead, np.int64),
             },
         )
 
@@ -797,6 +833,7 @@ class _SegmentedCheckpoint:
         self._check_degrade()
 
         def materialize(src):
+            # smklint: disable=SMK111 -- HostSnapshot.get blocks on an already-dispatched async copy; the chunk watchdog bounds this boundary when armed
             return src.get() if isinstance(src, HostSnapshot) else src
 
         # materialize on the CALLER thread: in overlap mode this runs
@@ -893,6 +930,7 @@ def fit_subsets_chunked(
     stop_after_chunks: Optional[int] = None,
     nan_guard: bool = False,
     pipeline_stats: Optional[ChunkPipelineStats] = None,
+    domain_map: Optional[FailureDomainMap] = None,
 ) -> Optional[SubsetResult]:
     """Run-log arming wrapper over :func:`_fit_subsets_chunked_impl`
     (which carries the full executor docstring).
@@ -915,6 +953,7 @@ def fit_subsets_chunked(
             mesh=mesh, chunk_size=chunk_size, progress=progress,
             stop_after_chunks=stop_after_chunks, nan_guard=nan_guard,
             pipeline_stats=pstats, run_log=run_log,
+            domain_map=domain_map,
         )
     from smk_tpu.obs.events import open_run_log
 
@@ -946,6 +985,7 @@ def fit_subsets_chunked(
                 stop_after_chunks=stop_after_chunks,
                 nan_guard=nan_guard,
                 pipeline_stats=pstats, run_log=run_log,
+                domain_map=domain_map,
             )
     finally:
         run_log.close()
@@ -968,6 +1008,7 @@ def _fit_subsets_chunked_impl(
     nan_guard: bool = False,
     pipeline_stats: Optional[ChunkPipelineStats] = None,
     run_log=None,
+    domain_map: Optional[FailureDomainMap] = None,
 ) -> Optional[SubsetResult]:
     """Unified chunked K-subset executor: the whole MCMC (burn-in AND
     sampling) runs as a host loop of ``chunk_iters``-long compiled
@@ -1026,6 +1067,22 @@ def _fit_subsets_chunked_impl(
     are bit-identical to ``"abort"`` — the engine adds one O(state)
     device clone per chunk and touches nothing inside the chunk
     programs.
+
+    Host-level resilience (ISSUE 11): ``domain_map`` (a
+    parallel/domains.FailureDomainMap; derived from the mesh /
+    process topology when None) attributes every fault, retry, and
+    death to a failure domain — a WHOLE-domain fault (all of a
+    domain's live subsets non-finite at one boundary) is handled as
+    one event on the domain's own retry ladder, and exhaustion kills
+    the domain as a unit. ``model.config.watchdog`` arms a per-chunk
+    deadline (parallel/domains.ChunkWatchdog) that converts a hung
+    dispatch or stuck collective into a typed ChunkTimeoutError
+    naming the implicated domains. The domain attribution rides in
+    the v7 checkpoint manifest, and resume onto a DIFFERENT (smaller)
+    topology is legal: the map is re-derived, surviving subsets are
+    re-laid onto the remaining hosts, and their draws are
+    bit-identical (each subset's chain depends only on its data
+    slice and key).
 
     ``stop_after_chunks`` ends the run early after that many chunks
     (burn or sampling), returning None with the checkpoint on disk —
@@ -1149,19 +1206,44 @@ def _fit_subsets_chunked_impl(
         "filled": np.asarray([0], np.int64),
         "fault_attempts": np.zeros(k, np.int64),
         "fault_dead": np.zeros(k, np.int64),
+        "fault_domain": np.zeros(k, np.int64),
+        "fault_domain_attempts": np.zeros(1, np.int64),
+        "fault_domain_dead": np.zeros(1, np.int64),
     }
 
     mode = cfg.chunk_pipeline
     policy_q = cfg.fault_policy == "quarantine"
+    # failure-domain attribution (ISSUE 11, parallel/domains.py):
+    # subset → device → process/host. Host-side metadata only — it
+    # never enters a compiled program or the run identity, which is
+    # what makes elastic resume onto a different topology legal.
+    if domain_map is None:
+        domain_map = FailureDomainMap.derive(k, mesh)
+    elif domain_map.k != k:
+        raise ValueError(
+            f"domain_map covers {domain_map.k} subsets but the "
+            f"partition has K={k}"
+        )
     # quarantine bookkeeping, host-side (mutated in place; the
     # checkpoint snapshots copies per boundary): per-subset relaunch
-    # attempt counts and the permanently-dead mask
+    # attempt counts and the permanently-dead mask, plus the
+    # per-DOMAIN retry ladders (a whole-domain fault is ONE event on
+    # ONE ladder, not len(domain) subset ladders)
     attempts = np.zeros(k, np.int64)
     dead = np.zeros(k, bool)
+    domain_attempts = np.zeros(domain_map.n_domains, np.int64)
+    domain_dead = np.zeros(domain_map.n_domains, bool)
+    domain_arr = np.asarray(domain_map.domain_of_subset, np.int64)
     pstats = pipeline_stats
     if pstats is not None:
         pstats.mode = mode
         pstats.fault_policy = cfg.fault_policy
+        if domain_map.n_domains > 1:
+            # domain attribution is surfaced only when there IS a
+            # topology to attribute to — under the degenerate
+            # one-domain map (plain single-host run) fault_summary()
+            # keeps the PR 7 record shape byte-identically
+            pstats.domain_of_subset = domain_arr.tolist()
 
     writer = (
         BackgroundWriter()
@@ -1181,6 +1263,8 @@ def _fit_subsets_chunked_impl(
             ),
             fault_src=lambda: (
                 attempts.copy(), dead.astype(np.int64),
+                domain_arr.copy(), domain_attempts.copy(),
+                domain_dead.astype(np.int64),
             ),
         )
 
@@ -1197,7 +1281,8 @@ def _fit_subsets_chunked_impl(
                 "the n_chains meta + sampled identity, v5 the "
                 "incremental draw-segment layout: the file is now a "
                 "manifest and kept draws live in sidecar "
-                "<path>.segNNNNN.npz files, v6 the per-segment "
+                "<path>.segNNNNN.npz files, v7 the failure-domain "
+                "attribution, v6 the per-segment "
                 "integrity checksums + fault-quarantine bookkeeping) "
                 "— it was written by an older build or for a "
                 "different run shape; delete the file or pass a "
@@ -1237,6 +1322,40 @@ def _fit_subsets_chunked_impl(
             )
         attempts[:] = np.asarray(ckpt["fault_attempts"], np.int64)
         dead[:] = np.asarray(ckpt["fault_dead"], np.int64) != 0
+        # v7 failure-domain bookkeeping: a same-topology resume
+        # adopts the per-domain retry ladders; a DIFFERENT topology
+        # (elastic resume — e.g. fewer hosts after a domain death)
+        # re-derives the attribution onto the current layout and
+        # resets the ladders (the new hosts are new hardware), while
+        # the per-subset deaths above persist either way
+        ck_dom = np.asarray(ckpt["fault_domain"], np.int64)
+        ck_dom_att = np.asarray(
+            ckpt["fault_domain_attempts"], np.int64
+        )
+        ck_dom_dead = np.asarray(ckpt["fault_domain_dead"], np.int64)
+        if (
+            ck_dom.shape[0] == k
+            and np.array_equal(ck_dom, domain_arr)
+            and ck_dom_att.shape[0] == domain_map.n_domains
+        ):
+            domain_attempts[:] = ck_dom_att
+            domain_dead[:] = ck_dom_dead != 0
+        elif (
+            not np.array_equal(ck_dom, domain_arr)
+            or ck_dom_att.shape[0] != domain_map.n_domains
+        ):
+            warnings.warn(
+                "elastic resume: the checkpoint was written under a "
+                f"different failure-domain topology "
+                f"({ck_dom_att.shape[0]} domains) than the current "
+                f"one ({domain_map.n_domains}); surviving subsets "
+                "are re-laid onto the current topology (their chains "
+                "are untouched — subset draws depend only on data "
+                "and keys), per-subset deaths persist, and the "
+                "per-domain retry ladders reset",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         lead = (k,) if cfg.n_chains == 1 else (k, cfg.n_chains)
         if policy_q:
             # lenient: a corrupt/truncated/checksum-failed segment
@@ -1542,6 +1661,40 @@ def _fit_subsets_chunked_impl(
         else None
     )
 
+    # Chunk watchdog (ISSUE 11, parallel/domains.ChunkWatchdog): each
+    # guarded section runs on a watchdog worker thread while this
+    # thread waits out the deadline — a hung dispatch or stuck
+    # collective becomes a typed ChunkTimeoutError naming the
+    # implicated failure domains instead of an indefinite hang.
+    # Observational only: the guarded closures perform the exact same
+    # dispatches in the same order (bit-identity armed vs off is
+    # probe-pinned in FAULTS_DOMAIN_r12.jsonl), the first section runs
+    # unguarded (it legitimately pays compile), and worker exceptions
+    # — including the quarantine engine's _QuarantineRewind control
+    # flow — propagate unchanged.
+    watchdog = (
+        ChunkWatchdog(
+            domain_map,
+            min_deadline_s=cfg.watchdog_min_deadline_s,
+            margin=cfg.watchdog_margin,
+            run_log=run_log,
+        )
+        if cfg.watchdog
+        else None
+    )
+
+    def _guarded(fn, chunk, iteration, novel=False):
+        """``novel`` marks a dispatch section whose (kind, length)
+        program has not been dispatched in this run: it legitimately
+        pays trace/compile, so it runs unguarded AND unobserved — a
+        compile wall folded into the deadline estimate would inflate
+        every later deadline by margin x compile (delaying real hang
+        detection), and a deadline derived without it could kill the
+        healthy compile itself."""
+        if watchdog is None or novel:
+            return fn()
+        return watchdog.run(fn, chunk=chunk, iteration=iteration)
+
     def dispatch(kind, start, n, w_ofs):
         """Issue one chunk's device work; returns the new carry."""
         nonlocal state, param_draws, w_draws, it
@@ -1570,39 +1723,77 @@ def _fit_subsets_chunked_impl(
         if kind != "fill":
             it = start + n
 
+    def _live_subsets(d):
+        return [
+            int(j) for j in domain_map.subsets_of(d) if not dead[j]
+        ]
+
     def quarantine_check(b, finite):
         """fault_policy="quarantine" at one boundary: classify newly
         non-finite subsets (already-dead ones are expected to stay
         non-finite and are ignored) into retries and exhausted
-        deaths. Raises :class:`_QuarantineRewind` when any subset has
+        deaths. Raises :class:`_QuarantineRewind` when any unit has
         retry budget left — the loop rewinds the chunk; with only
         deaths, falls through so the run continues degraded (the
         dead subsets' draws stay non-finite and the combine-side
-        survival mask drops them)."""
+        survival mask drops them).
+
+        Failure-domain attribution (ISSUE 11): with more than one
+        domain in the map, a WHOLE-domain fault — every live subset
+        of a domain non-finite at once, the signature of a dead
+        chip/host rather than a sick chain — is ONE event on the
+        domain's OWN retry ladder (``domain_attempts``), not
+        len(domain) independent subset ladders; exhaustion kills the
+        whole domain as one unit. Partial-domain faults keep PR 7's
+        per-subset semantics exactly, as does the degenerate
+        one-domain map (a plain single-host run)."""
         bad = (~finite.astype(bool)) & (~dead)
         if not bad.any():
             return
+        # whole-domain faults first: one unit, one ladder per domain
+        dom_hit = (
+            domain_map.whole_domain_faults(bad, dead)
+            if domain_map.n_domains > 1 else []
+        )
+        dom_retried, dom_dropped = [], []
+        # the domain's live-subset roster, frozen BEFORE any death is
+        # finalized below (death attribution must reference it)
+        dom_live = {int(d): _live_subsets(d) for d in dom_hit}
+        dom_subsets: set = set()
+        for d in dom_hit:
+            dom_subsets.update(dom_live[int(d)])
+            domain_attempts[d] += 1
+            if domain_attempts[d] > cfg.fault_max_retries:
+                dom_dropped.append(int(d))
+            else:
+                dom_retried.append(int(d))
         retried, dropped = [], []
         for j in np.where(bad)[0]:
+            if int(j) in dom_subsets:
+                continue
             attempts[j] += 1
             if attempts[j] > cfg.fault_max_retries:
                 dropped.append(int(j))
             else:
                 retried.append(int(j))
-        deferred = []
-        if retried:
+        retry_subsets = list(retried)
+        for d in dom_retried:
+            retry_subsets += dom_live[d]
+        deferred, dom_deferred, dom_spared = [], [], []
+        if retry_subsets:
             # a rewind replays the WHOLE chunk from its held state —
-            # an exhausted subset therefore gets an (un-forked)
+            # an exhausted unit therefore gets an (un-forked)
             # replay for free. Death is DEFERRED, not finalized: if
-            # the fault was transient and the subset's chain recovers
-            # on the replay, finalizing now would report a subset as
+            # the fault was transient and the chain recovers on the
+            # replay, finalizing now would report a subset as
             # dropped whose draws end finite — the accounting
             # (pstats/bench/manifest) must never contradict the data
             # (api derives the combine mask from grid finiteness).
             # A deterministic fault simply recurs on the replay and
             # dies at the next boundary with no retries pending.
             deferred, dropped = dropped, []
-        elif dropped and b["index"] == len(plan) - 1:
+            dom_deferred, dom_dropped = dom_dropped, []
+        elif (dropped or dom_dropped) and b["index"] == len(plan) - 1:
             # terminal boundary: no later chunk exists for a NaN
             # carry to poison, so "dead" is real only if the fault
             # reached the RECORDED draws — a final-sweep state fault
@@ -1611,6 +1802,9 @@ def _fit_subsets_chunked_impl(
             # accounting-matches-data invariant as deferral, at the
             # one boundary with no replay to re-verdict). One (K,)
             # reduce over the accumulators, paid at most once.
+            # Domain drops resolve at SUBSET granularity here: a
+            # domain with any finite-data subset is not branded dead
+            # (its spared subsets survive; only the rest die).
             with explicit_d2h("terminal_guard", nbytes=k):
                 draws_ok = np.asarray(
                     _subset_draws_finite(param_draws, w_draws)
@@ -1619,34 +1813,74 @@ def _fit_subsets_chunked_impl(
             if spared:
                 deferred += spared
                 dropped = [j for j in dropped if not draws_ok[j]]
-        for j in dropped:
+            still_dropped = []
+            for d in dom_dropped:
+                subs = dom_live[d]
+                sp = [j for j in subs if draws_ok[j]]
+                if sp:
+                    deferred += sp
+                    dropped += [j for j in subs if not draws_ok[j]]
+                    dom_spared.append(d)
+                else:
+                    still_dropped.append(d)
+            dom_dropped = still_dropped
+        # finalize deaths: per-subset drops plus whole-domain drops
+        # (a dropped domain kills every live subset it owns at once)
+        dom_dropped_subsets = []
+        for d in dom_dropped:
+            dom_dropped_subsets += dom_live[d]
+            domain_dead[d] = True
+        for j in dropped + dom_dropped_subsets:
             dead[j] = True
+        dom_deferred_subsets = []
+        for d in dom_deferred:
+            dom_deferred_subsets += dom_live[d]
+        all_dropped = sorted(dropped + dom_dropped_subsets)
+        all_deferred = sorted(deferred + dom_deferred_subsets)
         warnings.warn(
             "subset state non-finite in subsets "
-            f"{retried + dropped + deferred} at iteration {b['it']} "
-            "(fault_policy='quarantine'): "
-            f"retrying {retried or 'none'} from their chunk-start "
-            f"state with forked keys; dropping {dropped or 'none'} "
-            f"(retry ladder of {cfg.fault_max_retries} exhausted)"
+            f"{sorted(retry_subsets) + all_dropped + all_deferred} "
+            f"at iteration {b['it']} (fault_policy='quarantine'): "
+            f"retrying {sorted(retry_subsets) or 'none'} from their "
+            f"chunk-start state with forked keys; dropping "
+            f"{all_dropped or 'none'} (retry ladder of "
+            f"{cfg.fault_max_retries} exhausted)"
             + (
-                f"; death of {deferred} deferred pending the replay"
-                if deferred else ""
+                f"; death of {all_deferred} deferred pending the "
+                "replay"
+                if all_deferred else ""
+            )
+            + (
+                "; whole-domain faults: "
+                + ", ".join(
+                    f"domain {d} ({domain_map.labels[d]})"
+                    for d in dom_retried + dom_dropped + dom_deferred
+                )
+                if dom_retried or dom_dropped or dom_deferred
+                else ""
             ),
             RuntimeWarning,
             stacklevel=3,
         )
         if pstats is not None:
+            att = {
+                j: int(attempts[j])
+                for j in retried + dropped + deferred
+            }
+            for d in dom_retried + dom_dropped + dom_deferred + dom_spared:
+                for j in dom_live[d]:
+                    att[int(j)] = int(domain_attempts[d])
             pstats.record_fault(
                 chunk=b["index"], iteration=b["it"], phase=b["phase"],
-                retried=retried, dropped=dropped, deferred=deferred,
-                attempts={
-                    j: int(attempts[j])
-                    for j in retried + dropped + deferred
-                },
+                retried=sorted(retry_subsets), dropped=all_dropped,
+                deferred=all_deferred, attempts=att,
+                domains_retried=dom_retried,
+                domains_dropped=dom_dropped,
+                domains_deferred=dom_deferred,
             )
-        if retried:
+        if retry_subsets:
             mask = np.zeros(k, bool)
-            mask[retried] = True
+            mask[retry_subsets] = True
             raise _QuarantineRewind(mask)
 
     def boundary_host_work(b, stall):
@@ -1872,6 +2106,12 @@ def _fit_subsets_chunked_impl(
     try:
         idx = 0
         pending = None
+        # (kind, length) pairs already dispatched in THIS run — the
+        # first dispatch of each pair may trace/compile and is
+        # excluded from the watchdog deadline AND its estimate
+        # (rewind replays re-dispatch seen pairs, so they stay
+        # guarded)
+        seen_programs: set = set()
         while True:
             if idx < len(plan):
                 kind, start, n, w_ofs = plan[idx]
@@ -1882,14 +2122,21 @@ def _fit_subsets_chunked_impl(
                             "profile_start", chunk=idx,
                             out_dir=prof.out_dir,
                         )
-                held = _held_clone(state) if policy_q else None
-                dispatch(kind, start, n, w_ofs)
-                b = boundary_record(
-                    idx, kind, start, n,
-                    monotonic() - t0,
-                )
-                b["held"] = held
-                b["start"] = start
+                def _chunk_work(kind=kind, start=start, n=n,
+                                w_ofs=w_ofs, idx=idx, t0=t0):
+                    held = _held_clone(state) if policy_q else None
+                    dispatch(kind, start, n, w_ofs)
+                    rec = boundary_record(
+                        idx, kind, start, n,
+                        monotonic() - t0,
+                    )
+                    rec["held"] = held
+                    rec["start"] = start
+                    return rec
+
+                novel = (kind, n) not in seen_programs
+                seen_programs.add((kind, n))
+                b = _guarded(_chunk_work, idx, start + n, novel=novel)
                 idx += 1
                 if mode == "overlap":
                     # chunk idx's work is now queued on the device;
@@ -1906,7 +2153,12 @@ def _fit_subsets_chunked_impl(
             if todo is None:
                 continue
             try:
-                boundary_host_work(todo, stall=stall)
+                _guarded(
+                    lambda t=todo, s=stall: boundary_host_work(
+                        t, stall=s
+                    ),
+                    todo["index"], todo["it"],
+                )
             except _QuarantineRewind as rw:
                 apply_rewind(todo, rw)
                 idx = todo["index"]
@@ -1981,6 +2233,7 @@ def fit_subsets_checkpointed(
     progress=None,
     nan_guard: bool = False,
     pipeline_stats: Optional[ChunkPipelineStats] = None,
+    domain_map: Optional[FailureDomainMap] = None,
 ) -> Optional[SubsetResult]:
     """K-subset fan-out with periodic checkpointing and resume — the
     checkpoint-requiring entry point over ``fit_subsets_chunked`` (see
@@ -1995,6 +2248,7 @@ def fit_subsets_checkpointed(
         stop_after_chunks=stop_after_chunks,
         nan_guard=nan_guard,
         pipeline_stats=pipeline_stats,
+        domain_map=domain_map,
     )
 
 

@@ -28,6 +28,22 @@ Injectors:
   the crash window AFTER the segment landed and BEFORE the manifest
   published it.
 
+Host-level injectors (ISSUE 11):
+
+- :func:`stall_chunk` — block the chunk dispatch covering a chosen
+  iteration until the context exits (or a bounded fallback timeout),
+  simulating a hung dispatch / stuck collective for the chunk
+  watchdog (parallel/domains.ChunkWatchdog) to convert into a typed
+  ``ChunkTimeoutError``.
+- :func:`dead_domain` — every subset of one failure domain
+  non-finite at a chosen boundary, persistently: the all-at-once
+  fault signature of a dead chip/host (process-gone analog), driving
+  the quarantine engine's whole-domain ladder.
+- :func:`flaky_coordinator` — the first N
+  ``jax.distributed.initialize`` attempts raise a transient
+  coordinator error, exercising ``init_distributed``'s
+  exponential-backoff retry ladder and its typed error taxonomy.
+
 smklint rule SMK108: these APIs may be imported/armed only under
 ``tests/`` and ``scripts/`` — a reference in ``smk_tpu/`` library
 code ships chaos to production fits and is a lint finding.
@@ -92,7 +108,25 @@ class SubsetNaNInjection:
 # e.g. a deterministic fault in one subset timed to co-occur with a
 # first fault in another, the retry-deferral scenario
 _active_nan: list[SubsetNaNInjection] = []
+_active_stall: list = []
 _nan_patched = False
+
+
+@dataclass
+class ChunkStallInjection:
+    """Arming state of :func:`stall_chunk`: the dispatch of the chunk
+    covering ``at_iteration`` blocks on ``release`` (set on context
+    exit — zero residue, no stuck threads survive the scope) or the
+    bounded ``max_stall_s`` fallback, ``max_fires`` times."""
+
+    at_iteration: int
+    max_fires: int = 1
+    max_stall_s: float = 600.0
+    fires: int = 0
+    stalled_at: list = field(default_factory=list)
+    release: threading.Event = field(
+        default_factory=threading.Event
+    )
 
 
 @jax.jit
@@ -119,15 +153,31 @@ def _ensure_nan_patched() -> None:
             # lookup time — the model's cache holds the clean
             # executable, so warm models inject and disarmed runs are
             # byte-for-byte untouched
-            if not _active_nan or key[0] not in ("burn", "samp"):
+            if (
+                not (_active_nan or _active_stall)
+                or key[0] not in ("burn", "samp")
+            ):
                 return fn
             kind, length = key[0], key[1]
 
             def wrapped(data, state, it):
                 out = fn(data, state, it)
+                start = int(np.asarray(it))
+                # hung-dispatch simulation (ISSUE 11): block until
+                # the arming context releases (its exit always does)
+                # or the bounded fallback expires — the chunk
+                # watchdog's deadline fires first and converts this
+                # into a typed ChunkTimeoutError
+                for st in list(_active_stall):
+                    if (
+                        start <= st.at_iteration < start + length
+                        and st.fires < st.max_fires
+                    ):
+                        st.fires += 1
+                        st.stalled_at.append(start)
+                        st.release.wait(timeout=st.max_stall_s)
                 if not _active_nan:
                     return out
-                start = int(np.asarray(it))
                 hits = []
                 for inj in list(_active_nan):
                     if not (
@@ -188,6 +238,104 @@ def inject_subset_nan(
     finally:
         with _arm_lock:
             _active_nan.remove(inj)
+
+
+# ---------------------------------------------------------------------------
+# host-level injectors (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+
+@contextmanager
+def stall_chunk(
+    at_iteration: int,
+    max_fires: int = 1,
+    max_stall_s: float = 600.0,
+):
+    """Arm a hung-dispatch simulation: the chunk whose iteration
+    range covers ``at_iteration`` blocks inside its dispatch until
+    this context exits (the ``finally`` sets the release event — zero
+    residue, no thread outlives the scope) or ``max_stall_s``
+    elapses, ``max_fires`` times. Under ``SMKConfig.watchdog`` the
+    chunk watchdog's deadline fires during the stall and raises
+    :class:`~smk_tpu.parallel.domains.ChunkTimeoutError` naming the
+    implicated failure domains — the protocol's
+    stalled-chunk-to-typed-error conversion leg. Yields the injection
+    record (``fires``/``stalled_at``)."""
+    _ensure_nan_patched()
+    inj = ChunkStallInjection(
+        at_iteration=int(at_iteration),
+        max_fires=int(max_fires),
+        max_stall_s=float(max_stall_s),
+    )
+    with _arm_lock:
+        _active_stall.append(inj)
+    try:
+        yield inj
+    finally:
+        with _arm_lock:
+            _active_stall.remove(inj)
+        inj.release.set()
+
+
+@contextmanager
+def dead_domain(
+    subsets,
+    at_iteration: int,
+    max_fires: int = 99,
+):
+    """Arm the dead-host analog: EVERY subset in ``subsets`` (one
+    failure domain's roster — parallel/domains.FailureDomainMap
+    .subsets_of) goes non-finite at the boundary covering
+    ``at_iteration``, persistently (``max_fires`` high enough to
+    survive every quarantine replay). The quarantine engine sees a
+    whole-domain fault — all live subsets of the domain non-finite at
+    once — and runs it through the DOMAIN retry ladder as one event
+    (parallel/recovery.py). Yields the list of per-subset injection
+    records."""
+    import contextlib
+
+    with contextlib.ExitStack() as stack:
+        injs = [
+            stack.enter_context(
+                inject_subset_nan(
+                    int(j), int(at_iteration), max_fires=max_fires
+                )
+            )
+            for j in subsets
+        ]
+        yield injs
+
+
+@contextmanager
+def flaky_coordinator(fail_first: int, passthrough: bool = False):
+    """Arm the transient-coordinator injector: the first
+    ``fail_first`` calls of ``jax.distributed.initialize`` raise a
+    transient (retryable-classified) coordinator error; later calls
+    pass through to the real initializer when ``passthrough`` (a real
+    multi-process bring-up surviving a flaky start) or return as a
+    no-op stub (unit tests of the backoff ladder, which must not
+    actually initialize a distributed client). Yields a counter dict
+    (``{"calls": n}``)."""
+    real = jax.distributed.initialize
+    counter = {"calls": 0}
+
+    def patched(*args, **kwargs):
+        counter["calls"] += 1
+        if counter["calls"] <= fail_first:
+            raise RuntimeError(
+                "UNAVAILABLE: chaos: injected transient coordinator "
+                f"failure (attempt {counter['calls']}; connection "
+                "timed out)"
+            )
+        if passthrough:
+            return real(*args, **kwargs)
+        return None
+
+    jax.distributed.initialize = patched
+    try:
+        yield counter
+    finally:
+        jax.distributed.initialize = real
 
 
 # ---------------------------------------------------------------------------

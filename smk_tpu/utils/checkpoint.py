@@ -26,12 +26,23 @@ import json
 import os
 import queue
 import threading
+import time
 import warnings
 import zlib
 from typing import Any, Callable, Optional
 
 import jax
 import numpy as np
+
+from smk_tpu.utils.tracing import monotonic
+
+# How long close() waits for in-flight background writes (and then
+# for the worker thread to exit) before warning and abandoning the
+# daemon thread — the exit path must never hang forever on a wedged
+# filesystem (SMK111). Per-segment writes are O(chunk) bytes; the
+# O(run) full rewrites happen inline via ensure_synced BEFORE close()
+# on every normal completion path.
+_CLOSE_TIMEOUT_S = 60.0
 
 
 def is_key_leaf(leaf: Any) -> bool:
@@ -218,21 +229,59 @@ class BackgroundWriter:
 
     def flush(self) -> None:
         """Block until every submitted job has executed (or been
-        skipped after an error). Does not raise — check ``error``."""
+        skipped after an error). Does not raise — check ``error``.
+
+        Unbounded BY CONTRACT: flush exists to drain for consistency
+        — the caller is about to read or rewrite the checkpoint the
+        pending jobs are still producing, so a deadline here would
+        trade a visible hang for silently torn state. The bounded
+        exit path is :meth:`close`."""
         if self._started:
+            # smklint: disable=SMK111 -- drain-for-consistency is unbounded by contract (a deadline here trades a visible hang for torn checkpoint state); close() is the bounded exit path
             self._q.join()
 
+    def _drain_bounded(self, timeout_s: float) -> bool:
+        """Wait up to ``timeout_s`` for every submitted job to
+        finish; True when fully drained. Polls the queue's
+        unfinished-task counter (exact: every job's ``finally`` runs
+        ``task_done``) instead of ``Queue.join()``, which has no
+        timeout."""
+        deadline = monotonic() + timeout_s
+        while self._q.unfinished_tasks:
+            if monotonic() >= deadline:
+                return False
+            time.sleep(0.05)
+        return True
+
     def close(self) -> None:
-        """Flush and stop the thread. Idempotent. Warns if a job
-        failed and nothing ever surfaced the error — the last-chunk
-        failure window where no later boundary exists to notice."""
+        """Drain (boundedly) and stop the thread. Idempotent. Warns
+        if a job failed and nothing ever surfaced the error — the
+        last-chunk failure window where no later boundary exists to
+        notice — and warns-and-abandons the daemon thread if a
+        wedged write keeps it from draining within
+        ``_CLOSE_TIMEOUT_S`` (the exit path must not hang forever;
+        an abandoned write still lands atomically or not at all)."""
         if self._closed:
             return
         self._closed = True
         if self._started:
-            self._q.join()
+            drained = self._drain_bounded(_CLOSE_TIMEOUT_S)
             self._q.put(None)
-            self._thread.join()
+            if drained:
+                self._thread.join(timeout=_CLOSE_TIMEOUT_S)
+            if not drained or self._thread.is_alive():
+                # pragma-free: reachable under a genuinely wedged
+                # filesystem write (chaos-tested via a blocked job)
+                warnings.warn(
+                    "background checkpoint writer did not drain "
+                    f"within {_CLOSE_TIMEOUT_S:.0f}s (a wedged "
+                    "filesystem write?); abandoning the daemon "
+                    "thread — the checkpoint may be missing its "
+                    "final boundary (every write is atomic-rename, "
+                    "so no torn file is possible)",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
         if self._error is not None and not self._error_acked:
             self._error_acked = True
             warnings.warn(
@@ -248,7 +297,13 @@ class BackgroundWriter:
 
     def _loop(self) -> None:
         while True:
-            job = self._q.get()
+            try:
+                # bounded wake-ups (SMK111): the writer must never be
+                # un-killable just because no job (or sentinel) ever
+                # arrives — e.g. a submitter that died mid-enqueue
+                job = self._q.get(timeout=1.0)
+            except queue.Empty:
+                continue
             if job is None:
                 break
             try:

@@ -140,6 +140,10 @@ class ChunkPipelineStats:
 
     mode: str = "sync"
     fault_policy: str = "abort"
+    # failure-domain attribution (ISSUE 11, parallel/domains.py):
+    # the (K,) subset → domain list the executor ran under (None
+    # before a chunked run arms it / on non-domain-aware callers)
+    domain_of_subset: Any = None
     chunks: List[Dict[str, Any]] = field(default_factory=list)
     fault_events: List[Dict[str, Any]] = field(default_factory=list)
     programs: List[Dict[str, Any]] = field(default_factory=list)
@@ -181,6 +185,9 @@ class ChunkPipelineStats:
         dropped: List[int],
         attempts: Dict[int, int],
         deferred: List[int] = (),
+        domains_retried: List[int] = (),
+        domains_dropped: List[int] = (),
+        domains_deferred: List[int] = (),
     ) -> None:
         """One quarantine event (parallel/recovery.py): at ``chunk``'s
         boundary (global ``iteration``), ``retried`` subsets were
@@ -190,7 +197,11 @@ class ChunkPipelineStats:
         at a boundary that also rewound — their death is pending the
         replay (a transient fault may recover there, a deterministic
         one dies at the next boundary). ``attempts`` maps each
-        involved subset to its attempt count so far."""
+        involved subset to its attempt count so far. The
+        ``domains_*`` lists (ISSUE 11) attribute WHOLE-domain faults:
+        a domain listed here faulted/died as one unit on its own
+        retry ladder, and the corresponding subset lists above
+        already include its expanded subsets."""
         ev = {
             "chunk": int(chunk),
             "iteration": int(iteration),
@@ -200,6 +211,12 @@ class ChunkPipelineStats:
             "deferred": [int(j) for j in deferred],
             "attempts": {int(j): int(n) for j, n in attempts.items()},
         }
+        if domains_retried or domains_dropped or domains_deferred:
+            ev["domains_retried"] = [int(d) for d in domains_retried]
+            ev["domains_dropped"] = [int(d) for d in domains_dropped]
+            ev["domains_deferred"] = [
+                int(d) for d in domains_deferred
+            ]
         with self._lock:
             self.fault_events.append(ev)
             self._emit("fault", ev)
@@ -323,16 +340,32 @@ class ChunkPipelineStats:
         return reduce(vals) if reduce is not None else vals[-1]
 
     def fault_summary(self) -> Dict[str, Any]:
-        """The retry-ladder history compressed for a bench record."""
+        """The retry-ladder history compressed for a bench record.
+
+        Keys beyond the PR 7 baseline appear only when failure-domain
+        attribution is in play (ISSUE 11) — ``domains_dropped`` (the
+        whole domains that died as units) and ``per_domain`` (fault
+        events and dropped subsets grouped by domain, resolvable only
+        when ``domain_of_subset`` is set) — so domain-unaware callers
+        see the historical summary byte-identically."""
         attempts: Dict[int, int] = {}
         dropped: List[int] = []
         retries = 0
+        dom_dropped: List[int] = []
+        any_domain_events = False
         for ev in self.fault_events:
             retries += len(ev["retried"])
             dropped.extend(ev["dropped"])
             for j, n in ev["attempts"].items():
                 attempts[j] = max(attempts.get(j, 0), n)
-        return {
+            if any(
+                key in ev
+                for key in ("domains_retried", "domains_dropped",
+                            "domains_deferred")
+            ):
+                any_domain_events = True
+                dom_dropped.extend(ev.get("domains_dropped", []))
+        out = {
             "policy": self.fault_policy,
             "n_events": len(self.fault_events),
             "retries_total": retries,
@@ -341,6 +374,34 @@ class ChunkPipelineStats:
                 str(j): attempts[j] for j in sorted(attempts)
             },
         }
+        if any_domain_events or self.domain_of_subset is not None:
+            out["domains_dropped"] = sorted(set(dom_dropped))
+            if self.domain_of_subset is not None:
+                doms = [int(d) for d in self.domain_of_subset]
+                per: Dict[str, Dict[str, Any]] = {}
+                for ev in self.fault_events:
+                    involved = {
+                        str(doms[int(j)])
+                        for j in set(
+                            ev["retried"] + ev["dropped"]
+                            + ev["deferred"]
+                        )
+                    }
+                    for d in involved:
+                        entry = per.setdefault(
+                            d, {"events": 0, "subsets_dropped": []}
+                        )
+                        entry["events"] += 1
+                    for j in ev["dropped"]:
+                        per[str(doms[int(j)])][
+                            "subsets_dropped"
+                        ].append(int(j))
+                for entry in per.values():
+                    entry["subsets_dropped"] = sorted(
+                        set(entry["subsets_dropped"])
+                    )
+                out["per_domain"] = per
+        return out
 
 
 @contextlib.contextmanager

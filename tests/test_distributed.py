@@ -118,3 +118,78 @@ class TestTwoProcessSharded:
         # measured drift ~3e-3 over the 40-iteration chain.
         ref = _single_process_reference()
         np.testing.assert_allclose(c0, ref, rtol=1e-2, atol=1e-2)
+
+
+class TestKillTheChild:
+    @pytest.mark.slow  # full 2-process bring-up + a deliberate hang
+    # bounded by the 60 s watchdog deadline
+    def test_dead_peer_surfaces_typed_timeout(self):
+        """ISSUE 11 kill-the-child leg: the two-process CPU job loses
+        its non-coordinator right after bring-up (worker 1 runs in
+        ``die_mid`` mode), so the coordinator's combine collective
+        waits on a dead peer. Under the chunk-watchdog deadline
+        (worker 0 in ``guard`` mode) the hang is converted into a
+        typed ChunkTimeoutError naming the implicated process
+        domains, printed as DCN_TIMEOUT — within the deadline, never
+        an indefinite hang (the harness timeout here is the
+        backstop, far above the 60 s watchdog deadline)."""
+        port = _free_port()
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        modes = {0: "guard", 1: "die_mid"}
+        procs = [
+            subprocess.Popen(
+                [
+                    sys.executable, WORKER, str(i), "2", str(port),
+                    modes[i],
+                ],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                env=env,
+                text=True,
+            )
+            for i in range(2)
+        ]
+        out1, err1 = procs[1].communicate(timeout=300)
+        assert procs[1].returncode == 0, (
+            f"die_mid worker rc={procs[1].returncode}\n{err1[-2000:]}"
+        )
+        assert "DCN_DYING" in out1
+        out0, err0 = procs[0].communicate(timeout=300)
+        assert procs[0].returncode == 0, (
+            f"guard worker rc={procs[0].returncode}\n{err0[-3000:]}"
+        )
+        # Either bounded, typed outcome proves the no-hang contract:
+        # the watchdog's ChunkTimeoutError (DCN_TIMEOUT, naming the
+        # process domains), or the transport surfacing the dead peer
+        # itself with a bounded transient error before the 60 s
+        # deadline (DCN_PEER_ERROR — gloo's ~30 s key-value deadline
+        # on CPU). An indefinite hang would instead trip
+        # communicate(timeout=300) above.
+        wd = [
+            ln for ln in out0.splitlines()
+            if ln.startswith("DCN_TIMEOUT ")
+        ]
+        peer = [
+            ln for ln in out0.splitlines()
+            if ln.startswith("DCN_PEER_ERROR ")
+        ]
+        assert wd or peer, (
+            "coordinator neither hung nor surfaced a typed "
+            f"error:\n{out0}\n{err0[-2000:]}"
+        )
+        if wd:
+            rec = json.loads(wd[0][len("DCN_TIMEOUT "):])
+            assert rec["process_id"] == 0
+            assert rec["deadline_s"] == 60.0
+            # the domain map spans both processes: the error names
+            # them
+            assert rec["domains"], rec
+            assert all(
+                lab.startswith("process:")
+                for lab in rec["domain_labels"]
+            )
+        else:
+            rec = json.loads(peer[0][len("DCN_PEER_ERROR "):])
+            assert rec["process_id"] == 0
+            assert rec["error"]

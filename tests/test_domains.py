@@ -1,0 +1,441 @@
+"""Failure-domain topology + chunk watchdog + hardened distributed
+bring-up (ISSUE 11): the in-gate units the acceptance criteria name —
+domain mapping, watchdog deadline math, the backoff schedule, and the
+domain-granular survival mask — plus one compact integration leg
+(shared warm model): watchdog armed vs off bit-identity and the
+stalled-chunk → typed ChunkTimeoutError conversion. The heavier legs
+(dead-domain degradation, elastic resume on a reduced topology,
+exact-ledger/zero-compile guards) are pinned by
+scripts/chaos_probe.py --domains → FAULTS_DOMAIN_r12.jsonl.
+"""
+
+# smklint: test-budget=host-side units are milliseconds; the one integration class shares a single m=16 warm model (~10 s total on CPU)
+
+import threading
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from smk_tpu.config import SMKConfig
+from smk_tpu.parallel.combine import (
+    DomainSurvivalError,
+    SubsetSurvivalError,
+    apply_survival_mask,
+)
+from smk_tpu.parallel.domains import (
+    ChunkTimeoutError,
+    ChunkWatchdog,
+    FailureDomainMap,
+)
+from smk_tpu.parallel import distributed as dist
+
+
+class TestFailureDomainMap:
+    def test_single_host_degenerate(self):
+        m = FailureDomainMap.single_host(6)
+        assert m.n_domains == 1
+        assert m.k == 6
+        assert m.subsets_of(0).tolist() == [0, 1, 2, 3, 4, 5]
+
+    def test_from_n_domains_contiguous_blocks(self):
+        m = FailureDomainMap.from_n_domains(8, 4)
+        assert m.domain_of_subset == (0, 0, 1, 1, 2, 2, 3, 3)
+        assert m.labels == (
+            "domain:0", "domain:1", "domain:2", "domain:3"
+        )
+        # ragged split: leading domains take the remainder
+        m = FailureDomainMap.from_n_domains(5, 2)
+        assert m.domain_of_subset == (0, 0, 0, 1, 1)
+
+    def test_from_mesh_device_granularity(self):
+        # conftest exports 8 virtual CPU devices; all share process 0,
+        # so process granularity collapses to one domain and device
+        # granularity gives one domain per chip
+        from smk_tpu.parallel.executor import make_mesh
+
+        mesh = make_mesh(4)
+        m = FailureDomainMap.from_mesh(8, mesh, granularity="device")
+        assert m.n_domains == 4
+        assert m.subsets_of(0).tolist() == [0, 1]
+        proc = FailureDomainMap.from_mesh(8, mesh)
+        assert proc.n_domains == 1
+        assert proc.labels == ("process:0",)
+
+    def test_derive_defaults(self):
+        assert FailureDomainMap.derive(4, None).n_domains == 1
+
+    def test_derive_single_process_mesh_uses_device_granularity(self):
+        """A single-process multi-chip mesh must NOT collapse to one
+        domain — there the chip is the failure unit, and a
+        process-granular map would disable the whole-domain machinery
+        on exactly the sick-chip topology it exists for."""
+        from smk_tpu.parallel.executor import make_mesh
+
+        m = FailureDomainMap.derive(8, make_mesh(4))
+        assert m.n_domains == 4
+        assert all(lab.startswith("device:") for lab in m.labels)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="outside"):
+            FailureDomainMap(
+                domain_of_subset=(0, 2), labels=("a", "b")
+            )
+        with pytest.raises(ValueError, match="at least one subset"):
+            FailureDomainMap(
+                domain_of_subset=(0, 0), labels=("a", "b")
+            )
+        with pytest.raises(ValueError, match="n_domains"):
+            FailureDomainMap.from_n_domains(4, 5)
+
+    def test_whole_domain_faults(self):
+        m = FailureDomainMap.from_n_domains(6, 3)  # pairs
+        bad = np.array([True, True, True, False, False, False])
+        dead = np.zeros(6, bool)
+        assert m.whole_domain_faults(bad, dead) == [0]
+        # a dead subset doesn't block the verdict: the LIVE remainder
+        # of domain 1 is fully bad
+        dead2 = np.array([False, False, True, False, False, False])
+        bad2 = np.array([False, False, False, True, False, False])
+        assert m.whole_domain_faults(bad2, dead2) == [1]
+        # an entirely-dead domain is not a NEW fault
+        dead3 = np.array([True, True, False, False, False, False])
+        assert m.whole_domain_faults(
+            np.zeros(6, bool), dead3
+        ) == []
+
+
+class TestWatchdogDeadline:
+    def _wd(self, **kw):
+        kw.setdefault("min_deadline_s", 1.0)
+        kw.setdefault("margin", 3.0)
+        return ChunkWatchdog(FailureDomainMap.single_host(4), **kw)
+
+    def test_unarmed_until_first_observation(self):
+        wd = self._wd()
+        assert wd.deadline_s is None
+        # an unguarded run() still observes, arming later sections
+        assert wd.run(lambda: 42) == 42
+        assert wd.deadline_s is not None
+
+    def test_deadline_is_margin_times_max_recent_wall(self):
+        wd = self._wd(min_deadline_s=0.001, margin=3.0)
+        for w in (0.5, 2.0, 1.0):
+            wd.observe(w)
+        assert wd.estimate_s == 2.0
+        assert wd.deadline_s == pytest.approx(6.0)
+
+    def test_min_deadline_floor(self):
+        wd = self._wd(min_deadline_s=10.0, margin=2.0)
+        wd.observe(0.01)
+        assert wd.deadline_s == 10.0
+
+    def test_estimate_window_bounded(self):
+        from smk_tpu.parallel.domains import _ESTIMATE_WINDOW
+
+        wd = self._wd()
+        wd.observe(100.0)
+        for _ in range(_ESTIMATE_WINDOW):
+            wd.observe(1.0)
+        # the old spike rolled out of the window
+        assert wd.estimate_s == 1.0
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="margin"):
+            self._wd(margin=0.5)
+        with pytest.raises(ValueError, match="min_deadline_s"):
+            self._wd(min_deadline_s=0.0)
+
+    def test_run_propagates_results_and_exceptions(self):
+        wd = self._wd(min_deadline_s=5.0)
+        wd.observe(0.01)
+        assert wd.run(lambda: "ok") == "ok"
+
+        def boom():
+            raise KeyError("inner")
+
+        with pytest.raises(KeyError, match="inner"):
+            wd.run(boom)
+
+    def test_run_times_out_with_typed_error(self):
+        wd = self._wd(min_deadline_s=0.05, margin=1.0)
+        wd.observe(0.01)
+        ev = threading.Event()
+        try:
+            with pytest.raises(ChunkTimeoutError) as exc:
+                wd.run(
+                    lambda: ev.wait(timeout=30.0),
+                    chunk=7, iteration=42,
+                )
+        finally:
+            ev.set()  # release the abandoned worker
+        assert exc.value.chunk == 7
+        assert exc.value.iteration == 42
+        assert exc.value.domains == [0]
+        assert exc.value.domain_labels == ["process:0"]
+        assert "process:0" in str(exc.value)
+        assert wd.fired == 1
+
+    def test_explicit_deadline_override(self):
+        wd = self._wd(min_deadline_s=100.0)
+        ev = threading.Event()
+        try:
+            with pytest.raises(ChunkTimeoutError):
+                wd.run(
+                    lambda: ev.wait(timeout=30.0), deadline_s=0.05
+                )
+        finally:
+            ev.set()
+
+
+class TestBackoffAndInitGuard:
+    def test_backoff_schedule(self):
+        assert dist.backoff_schedule(0) == ()
+        assert dist.backoff_schedule(4, 1.0, 30.0) == (
+            1.0, 2.0, 4.0, 8.0,
+        )
+        # cap binds
+        assert dist.backoff_schedule(4, 1.0, 5.0) == (
+            1.0, 2.0, 4.0, 5.0,
+        )
+        with pytest.raises(ValueError, match="retries"):
+            dist.backoff_schedule(-1)
+
+    def test_transient_classification(self):
+        assert dist._is_transient(
+            RuntimeError("DEADLINE_EXCEEDED: barrier timed out")
+        )
+        assert dist._is_transient(ConnectionRefusedError())
+        assert not dist._is_transient(
+            ValueError("num_processes must be set")
+        )
+
+    @pytest.fixture()
+    def fresh_state(self):
+        dist._reset_state_for_testing()
+        yield
+        dist._reset_state_for_testing()
+
+    def test_retry_ladder_and_typed_errors(self, fresh_state):
+        from smk_tpu.testing.faults import flaky_coordinator
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with flaky_coordinator(2) as ctr:
+                topo = dist.init_distributed(
+                    coordinator_address="127.0.0.1:1",
+                    num_processes=1, process_id=0,
+                    retries=3, backoff_s=0.001,
+                )
+        assert ctr["calls"] == 3  # 2 failures + 1 success
+        assert topo.num_processes >= 1
+        dist._reset_state_for_testing()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with flaky_coordinator(99):
+                with pytest.raises(
+                    dist.CoordinatorUnavailableError
+                ) as exc:
+                    dist.init_distributed(
+                        coordinator_address="127.0.0.1:1",
+                        num_processes=1, process_id=0,
+                        retries=2, backoff_s=0.001,
+                    )
+        assert exc.value.attempts == 3
+        # the taxonomy is catchable at the base
+        assert isinstance(exc.value, dist.DistributedInitError)
+
+    def test_non_transient_is_config_error(self, fresh_state):
+        real = jax.distributed.initialize
+        calls = {"n": 0}
+
+        def bad(*a, **kw):
+            calls["n"] += 1
+            raise ValueError("num_processes is required")
+
+        jax.distributed.initialize = bad
+        try:
+            with pytest.raises(dist.DistributedConfigError):
+                dist.init_distributed(
+                    coordinator_address="127.0.0.1:1",
+                    num_processes=1, process_id=0,
+                    retries=5, backoff_s=0.001,
+                )
+        finally:
+            jax.distributed.initialize = real
+        assert calls["n"] == 1  # never retried
+
+    def test_idempotence_guard(self, fresh_state):
+        from smk_tpu.testing.faults import flaky_coordinator
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with flaky_coordinator(0) as ctr:
+                topo = dist.init_distributed(
+                    coordinator_address="127.0.0.1:1",
+                    num_processes=1, process_id=0,
+                )
+                # identical topology: warned no-op, same object, the
+                # underlying initializer is NOT called again
+                with pytest.warns(RuntimeWarning, match="identical"):
+                    topo2 = dist.init_distributed(
+                        coordinator_address="127.0.0.1:1",
+                        num_processes=1, process_id=0,
+                    )
+        assert topo2 is topo
+        assert ctr["calls"] == 1
+        with pytest.raises(
+            dist.DistributedConfigError, match="one initialization"
+        ):
+            dist.init_distributed(
+                coordinator_address="127.0.0.1:2",
+                num_processes=2, process_id=1,
+            )
+
+
+class TestDomainSurvivalMask:
+    def _grids(self, k=4):
+        return jnp.zeros((k, 5, 2), jnp.float32)
+
+    def test_domain_floor_binds_where_subset_floor_passes(self):
+        # asymmetric 3+1 map losing its small domain: 3/4 subsets
+        # survive (floor passes at 0.7) but 1/2 domains (floor fails)
+        mask = np.array([True, True, True, False])
+        doms = (0, 0, 0, 1)
+        out = apply_survival_mask(
+            self._grids(), mask, min_surviving_frac=0.7
+        )
+        assert out.shape[0] == 3
+        with pytest.raises(DomainSurvivalError) as exc:
+            apply_survival_mask(
+                self._grids(), mask, min_surviving_frac=0.7,
+                domain_of_subset=doms,
+            )
+        assert "failure domains" in str(exc.value)
+        # catchable as the subset-level error (subclass)
+        assert isinstance(exc.value, SubsetSurvivalError)
+
+    def test_all_true_mask_returns_grids_unchanged(self):
+        g = self._grids()
+        out = apply_survival_mask(
+            g, np.ones(4, bool), min_surviving_frac=1.0,
+            domain_of_subset=(0, 0, 1, 1),
+        )
+        assert out is g
+
+    def test_domain_floor_passes_when_every_domain_survives(self):
+        mask = np.array([True, False, True, False])
+        out = apply_survival_mask(
+            self._grids(), mask, min_surviving_frac=0.5,
+            domain_of_subset=(0, 0, 1, 1),
+        )
+        assert out.shape[0] == 2
+
+    def test_domain_vector_length_validated(self):
+        with pytest.raises(ValueError, match="domain_of_subset"):
+            apply_survival_mask(
+                self._grids(), np.ones(4, bool),
+                domain_of_subset=(0, 0, 1),
+            )
+
+
+# ---------------------------------------------------------------------------
+# compact integration: one shared warm model (module-scoped fixtures)
+# ---------------------------------------------------------------------------
+
+K = 4
+CFG = SMKConfig(
+    n_subsets=K, n_samples=12, burn_in_frac=0.5, phi_update_every=2,
+    fault_policy="quarantine",
+)
+
+
+@pytest.fixture(scope="module")
+def problem():
+    rng = np.random.default_rng(7)
+    n, q, p, t = 64, 1, 2, 3
+    coords = jnp.asarray(rng.uniform(size=(n, 2)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(n, q, p)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, size=(n, q)), jnp.float32)
+    ct = jnp.asarray(rng.uniform(size=(t, 2)), jnp.float32)
+    xt = jnp.asarray(rng.normal(size=(t, q, p)), jnp.float32)
+    from smk_tpu.parallel.partition import random_partition
+
+    part = random_partition(jax.random.key(0), y, x, coords, K)
+    return part, ct, xt, jax.random.key(1)
+
+
+def _run(model, problem, **kw):
+    from smk_tpu.parallel.recovery import fit_subsets_chunked
+
+    part, ct, xt, key = problem
+    return fit_subsets_chunked(
+        model, part, ct, xt, key, chunk_iters=4, **kw
+    )
+
+
+class TestWatchdogIntegration:
+    @pytest.mark.slow  # two full m=16 program-set compiles (~60 s);
+    # the same claim is probe-pinned in FAULTS_DOMAIN_r12.jsonl
+    def test_armed_vs_off_bit_identical(self, problem):
+        """The watchdog observes and times, never steers: draws are
+        bit-identical armed vs off (the armed run re-dispatches the
+        same programs from its watchdog worker thread)."""
+        import dataclasses
+
+        from smk_tpu.models.probit_gp import SpatialProbitGP
+
+        ref = _run(SpatialProbitGP(CFG, weight=1), problem)
+        armed_model = SpatialProbitGP(
+            dataclasses.replace(
+                CFG, watchdog=True, watchdog_min_deadline_s=30.0,
+                watchdog_margin=10.0,
+            ),
+            weight=1,
+        )
+        armed = _run(
+            armed_model, problem,
+            domain_map=FailureDomainMap.from_n_domains(K, 2),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ref.param_samples),
+            np.asarray(armed.param_samples),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ref.w_samples), np.asarray(armed.w_samples)
+        )
+
+    def test_stalled_chunk_becomes_typed_timeout(self, problem):
+        """The tentpole conversion: an injected hung dispatch under
+        an armed watchdog raises ChunkTimeoutError naming the
+        implicated failure domains instead of hanging forever."""
+        import dataclasses
+
+        from smk_tpu.models.probit_gp import SpatialProbitGP
+        from smk_tpu.testing.faults import stall_chunk
+
+        # n_samples=16 so the plan repeats a (samp, 4) chunk: the
+        # FIRST dispatch of each (kind, length) runs unguarded (it
+        # legitimately pays compile), so the stall must land on a
+        # repeated one — chunk [12, 16) is the second samp-4
+        model = SpatialProbitGP(
+            dataclasses.replace(
+                CFG, n_samples=16, watchdog=True,
+                watchdog_min_deadline_s=0.3, watchdog_margin=2.0,
+            ),
+            weight=1,
+        )
+        with stall_chunk(14, max_stall_s=60.0) as inj:
+            with pytest.raises(ChunkTimeoutError) as exc:
+                _run(
+                    model, problem,
+                    domain_map=FailureDomainMap.from_n_domains(K, 2),
+                )
+        assert inj.fires == 1
+        assert exc.value.domains  # names at least one domain
+        assert all(
+            lab.startswith("domain:")
+            for lab in exc.value.domain_labels
+        )

@@ -320,6 +320,52 @@ class TestRunLogDir:
                 "resample_predict"} <= span_names
 
 
+class TestResilienceKnobs:
+    def test_watchdog_and_dist_init_args_wired(self):
+        """The ISSUE 11 front-end additions: R ``watchdog`` and
+        ``dist.init.timeout.s`` must exist with safe defaults, feed
+        the matching SMKConfig fields, and the dropped failure
+        domains must surface as ``$domains.dropped``
+        (source-checked, same convention as the run-log wiring
+        test)."""
+        import os
+
+        r_src = open(
+            os.path.join(
+                os.path.dirname(os.path.dirname(__file__)),
+                "r", "meta_kriging_tpu.R",
+            )
+        ).read()
+        assert "watchdog = FALSE" in r_src
+        assert "dist.init.timeout.s = 120" in r_src
+        assert "watchdog = watchdog" in r_src
+        assert "dist_init_timeout_s = dist.init.timeout.s" in r_src
+        assert (
+            "domains.dropped = as.integer(unlist(res$domains_dropped))"
+            in r_src
+        )
+
+    def test_config_accepts_r_double_spellings(self):
+        """reticulate ships R numerics as Python floats: the new
+        int-like knob must coerce (dist_init_retries) and the float
+        knobs must validate."""
+        from smk_tpu.config import SMKConfig
+
+        cfg = SMKConfig(
+            dist_init_retries=2.0, dist_init_timeout_s=60.0,
+            watchdog=True, watchdog_min_deadline_s=5.0,
+            watchdog_margin=3.0,
+        )
+        assert cfg.dist_init_retries == 2
+        assert isinstance(cfg.dist_init_retries, int)
+        with pytest.raises(ValueError, match="watchdog_margin"):
+            SMKConfig(watchdog_margin=0.5)
+        with pytest.raises(ValueError, match="watchdog must be"):
+            SMKConfig(watchdog="yes")
+        with pytest.raises(ValueError, match="dist_init_timeout_s"):
+            SMKConfig(dist_init_timeout_s=0.0)
+
+
 class TestConfigOverrides:
     def test_overrides_merge_like_modifyList(self):
         """r/meta_kriging_tpu.R builds SMKConfig via

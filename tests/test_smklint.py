@@ -710,6 +710,110 @@ class TestTelemetryDiscipline:
             )
 
 
+class TestUnboundedWait:
+    """SMK111 (ISSUE 11): blocking waits without a timeout in
+    smk_tpu/ library code — the hang class the chunk watchdog
+    exists to catch."""
+
+    def test_zero_arg_waits_flagged(self):
+        for call in (
+            "q.get()", "t.join()", "fut.result()", "ev.wait()",
+            "lock.acquire()", "sock.accept()",
+        ):
+            src = f"def f(q, t, fut, ev, lock, sock):\n    {call}\n"
+            assert "SMK111" in rules_hit(src), call
+
+    def test_timeout_kwarg_and_operand_args_clean(self):
+        clean = (
+            "import os\n"
+            "def f(q, t, fut, ev, d, xs, sock):\n"
+            "    q.get(timeout=1.0)\n"
+            "    t.join(timeout=60.0)\n"
+            "    fut.result(timeout=5)\n"
+            "    ev.wait(timeout=0.5)\n"
+            "    d.get('key')\n"
+            "    s = ','.join(xs)\n"
+            "    p = os.path.join('a', 'b')\n"
+            "    sock.recv(1024)\n"
+            "    return s, p\n"
+        )
+        assert "SMK111" not in rules_hit(clean)
+
+    def test_socket_create_connection(self):
+        src = (
+            "import socket\n"
+            "def f(addr):\n"
+            "    return socket.create_connection(addr)\n"
+        )
+        assert "SMK111" in rules_hit(src)
+        # the from-import and module-alias spellings (the evasion
+        # class SMK110 was also extended to catch)
+        from_import = (
+            "from socket import create_connection as conn\n"
+            "def f(addr):\n"
+            "    return conn(addr)\n"
+        )
+        assert "SMK111" in rules_hit(from_import)
+        aliased = (
+            "import socket as s\n"
+            "def f(addr):\n"
+            "    return s.create_connection(addr)\n"
+        )
+        assert "SMK111" in rules_hit(aliased)
+        # an unrelated local create_connection is NOT socket's
+        local = (
+            "def create_connection(addr):\n"
+            "    return addr\n"
+            "def f(addr):\n"
+            "    return create_connection(addr)\n"
+        )
+        assert "SMK111" not in rules_hit(local)
+        timed = (
+            "import socket\n"
+            "def f(addr):\n"
+            "    return socket.create_connection(addr, 5.0)\n"
+        )
+        assert "SMK111" not in rules_hit(timed)
+        kw = (
+            "import socket\n"
+            "def f(addr):\n"
+            "    return socket.create_connection(addr, timeout=5.0)\n"
+        )
+        assert "SMK111" not in rules_hit(kw)
+
+    def test_scope_is_library_only(self):
+        src = "def f(q):\n    q.get()\n"
+        assert "SMK111" not in rules_hit(src, path=TESTS_PATH)
+        assert "SMK111" not in rules_hit(src, path=SCRIPT_PATH)
+        assert "SMK111" not in rules_hit(src, path="bench.py")
+        # the whole smk_tpu/ tree is in scope, incl. the harness
+        assert "SMK111" in rules_hit(
+            src, path="smk_tpu/testing/fixture.py"
+        )
+
+    def test_suppression_honored(self):
+        src = (
+            "def f(q):\n"
+            "    # smklint: disable=SMK111 -- bounded by construction in this fixture\n"
+            "    q.get()\n"
+        )
+        assert "SMK111" not in rules_hit(src)
+
+    def test_real_checkpoint_clean_and_seeded_defect_caught(self):
+        """Seeded defect on the REAL module: BackgroundWriter was
+        converted to bounded waits (get(timeout=), join(timeout=))
+        with two justified drain suppressions; pasting an unbounded
+        queue.get() back in must be caught."""
+        real = "smk_tpu/utils/checkpoint.py"
+        src = repo_file(real)
+        assert "SMK111" not in rules_hit(src, path=real)
+        broken = src + (
+            "\ndef _sneaky_drain(q):\n"
+            "    return q.get()\n"
+        )
+        assert "SMK111" in rules_hit(broken, path=real)
+
+
 class TestTreeGate:
     def test_repo_lints_clean(self):
         """The acceptance gate as a tier-1 test: zero unsuppressed
