@@ -70,6 +70,7 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
                                 checkpoint.path = NULL,
                                 compile.store.dir = NULL,
                                 run.log.dir = NULL,
+                                n.devices = NULL,
                                 backend = c("tpu", "cpu"),
                                 seed = 0L,
                                 python_path = NULL,
@@ -153,6 +154,19 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
   # stale (different jax/device) or corrupt artifact is rebuilt with
   # a warning, never mis-loaded. Implies the chunked executor (see
   # the README's "AOT & compile caching" section).
+  # n.devices: lay the n.core subsets over a device mesh of the
+  # first n.devices accelerator chips (ISSUE 12 — the scale-out
+  # axis). Passed through to the Python API's n_devices, which
+  # builds the mesh via the one sanctioned constructor
+  # (smk_tpu.parallel.executor.make_mesh); the whole
+  # fit -> combine -> predict pipeline then stays device-resident
+  # (the quantile-grid combine all-gathers ON the mesh, prediction
+  # runs row-sharded), and with compile.store.dir set the compiled
+  # programs are stored per mesh topology so a warm deployment pays
+  # zero compile. n.core must be divisible by n.devices. NULL
+  # (default) keeps the single-device path bit-identically; on a
+  # 1-device mesh results are also bit-identical to NULL (see the
+  # README's "Scale-out" section).
   # run.log.dir: directory for the structured per-fit run log
   # (ISSUE 10, smk_tpu/obs/). When set, every fit appends one JSONL
   # timeline file there — phases as nested spans, every chunk/fault/
@@ -237,6 +251,9 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
   }
   if (!is.null(checkpoint.path)) {
     extra$checkpoint_path <- checkpoint.path
+  }
+  if (!is.null(n.devices)) {
+    extra$n_devices <- as.integer(n.devices)
   }
   res <- do.call(smk$fit_meta_kriging, c(list(
     jax$random$key(as.integer(seed)),

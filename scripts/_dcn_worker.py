@@ -23,6 +23,13 @@ Usage: python scripts/_dcn_worker.py <process_id> <num_processes> <port> [mode]
   the collective, print ``DCN_TIMEOUT <json>`` (the typed
   ChunkTimeoutError, naming the implicated process domains) instead
   of hanging forever.
+- ``e2e`` (ISSUE 12, scripts/mesh_probe.py): the scale-out path —
+  the CHUNKED executor under the global 2-process mesh
+  (fit_subsets_chunked(mesh=...), the exact north-star engine), then
+  the ON-DEVICE combine (gather_grids all-gathers the K-sharded
+  grids across processes, the reduction runs replicated); prints the
+  combined digest plus the topology fingerprint the compile-store
+  buckets would key.
 """
 
 import json
@@ -85,6 +92,43 @@ def main():
     part = random_partition(jax.random.key(1), y, x, coords, k)
 
     mesh = make_mesh()  # global: one device per process
+
+    if mode == "e2e":
+        from smk_tpu.compile.programs import topology_fingerprint
+        from smk_tpu.parallel.combine import gather_grids
+        from smk_tpu.parallel.recovery import fit_subsets_chunked
+
+        res = fit_subsets_chunked(
+            model, part, coords_test, x_test, jax.random.key(2),
+            chunk_iters=20, mesh=mesh,
+        )
+        gathered = gather_grids(res.param_grid, mesh)
+        combined = np.asarray(
+            combine_quantile_grids(gathered, cfg.combiner)
+        )
+        combined_w = np.asarray(
+            combine_quantile_grids(
+                gather_grids(res.w_grid, mesh), cfg.combiner
+            )
+        )
+        print(
+            "DCN_E2E " + json.dumps({
+                "process_id": topo.process_id,
+                "num_processes": topo.num_processes,
+                "global_devices": topo.global_device_count,
+                "topology_fingerprint": list(
+                    topology_fingerprint(mesh)
+                ),
+                "combined_sum": float(combined.sum()),
+                "combined_w_sum": float(combined_w.sum()),
+                "finite": bool(
+                    np.isfinite(combined).all()
+                    and np.isfinite(combined_w).all()
+                ),
+            }),
+            flush=True,
+        )
+        return
 
     def fit_and_combine():
         res = fit_subsets_sharded(

@@ -1,4 +1,4 @@
-"""smklint rules SMK101–SMK111 — the repo's JAX invariants, each one
+"""smklint rules SMK101–SMK112 — the repo's JAX invariants, each one
 traceable to the PR that established it (see analysis/RULES.md).
 
 All rules are pure-AST (no jax import). Shared machinery:
@@ -1369,6 +1369,80 @@ class UnboundedWaitRule(Rule):
                 )
 
 
+# ---------------------------------------------------------------------------
+# SMK112 — mesh hygiene (one Mesh constructor, honest topology keys)
+# ---------------------------------------------------------------------------
+
+# modules Mesh is legitimately imported FROM (the constructor itself)
+_MESH_HOME_MODULES = {"jax.sharding", "jax.experimental.maps"}
+
+
+class MeshHygieneRule(Rule):
+    id = "SMK112"
+    name = "mesh-hygiene"
+    doc = (
+        "direct jax.sharding.Mesh(...) construction in smk_tpu/ "
+        "library code outside parallel/executor.py — "
+        "executor.make_mesh is the ONE mesh source of truth "
+        "(ISSUE 12): the topology-aware compile store keys "
+        "serialized executables by the mesh's fingerprint, and the "
+        "failure-domain attribution derives subset→device→host "
+        "placement from make_mesh's contiguous 1-D layout, so an "
+        "ad-hoc Mesh with a different device order or axis name "
+        "silently desynchronizes both"
+    )
+
+    def applies(self, module):
+        norm = module.norm_path()
+        if "smk_tpu/parallel/executor" in norm:
+            return False
+        return "smk_tpu/" in norm
+
+    @staticmethod
+    def _mesh_aliases(tree) -> Set[str]:
+        """Local names that ARE the Mesh constructor: ``from
+        jax.sharding import Mesh [as M]`` — the spelling every
+        in-tree user has. A locally defined name shadowing it is
+        deliberately not chased (same policy as SMK111's
+        create_connection aliasing)."""
+        out: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                if node.module in _MESH_HOME_MODULES and node.level == 0:
+                    for a in node.names:
+                        if a.name == "Mesh":
+                            out.add(a.asname or "Mesh")
+        return out
+
+    def check(self, module, ctx):
+        aliases = self._mesh_aliases(module.tree)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            direct = len(chain) == 1 and chain[0] in aliases
+            # attribute spellings: jax.sharding.Mesh(...), and the
+            # `from jax import sharding; sharding.Mesh(...)` form
+            attr = (
+                len(chain) >= 2
+                and chain[-1] == "Mesh"
+                and chain[-2] in ("sharding", "maps")
+            )
+            if direct or attr:
+                yield self.finding(
+                    module, node,
+                    "direct Mesh(...) construction in library code — "
+                    "build meshes through "
+                    "smk_tpu.parallel.executor.make_mesh (the one "
+                    "source of truth for device order and axis "
+                    "naming): the compile store's topology "
+                    "fingerprints and the failure-domain layout "
+                    "oracle (subset_device_assignment) both assume "
+                    "its contiguous 1-D layout, and an ad-hoc mesh "
+                    "silently desynchronizes them",
+                )
+
+
 ALL_RULES = [
     BatchingRuleRule(),
     HostNondeterminismRule(),
@@ -1381,4 +1455,5 @@ ALL_RULES = [
     CompileCacheConfigRule(),
     TelemetryDisciplineRule(),
     UnboundedWaitRule(),
+    MeshHygieneRule(),
 ]

@@ -168,6 +168,7 @@ def fit_meta_kriging(
     weight: int = 1,
     sharded: bool = False,
     mesh=None,
+    n_devices: Optional[int] = None,
     chunk_size: Optional[int] = None,
     chunk_iters: Optional[int] = None,
     checkpoint_path: Optional[str] = None,
@@ -186,7 +187,19 @@ def fit_meta_kriging(
     the reference's all-or-nothing foreach, R:102-114, has no
     equivalent of any of these):
 
-    - ``sharded``/``mesh``: K subsets laid out over the device mesh.
+    - ``sharded``/``mesh``/``n_devices``: K subsets laid out over the
+      device mesh — ``mesh`` passes one explicitly, ``n_devices``
+      builds a 1-D mesh over the first that many local devices
+      (``executor.make_mesh`` — the R front-end's ``n.devices``
+      pass-through), bare ``sharded=True`` meshes every visible
+      device. Under a mesh the WHOLE pipeline stays device-resident
+      (ISSUE 12): the per-subset quantile grids come home K-sharded,
+      the combine all-gathers them on the mesh (``gather`` span in
+      the run log), and the prediction composition runs with the
+      resampled draws row-sharded over the mesh
+      (parallel/sharded_chol.row_sharding) — on a 1-device mesh the
+      whole fit→combine→predict pipeline is bit-identical to the
+      unmeshed path.
     - ``chunk_size``: lax.map over K-chunks to bound resident memory.
     - ``chunk_iters``: run the MCMC as a host loop of this many
       iterations per compiled dispatch (required at scales where a
@@ -252,6 +265,23 @@ def fit_meta_kriging(
     armed vs off.
     """
     cfg = config or SMKConfig()
+    if n_devices is not None:
+        if mesh is not None:
+            # conflicting topology asks must not silently pick one:
+            # the same no-silent-downgrade policy as make_mesh's
+            # over-ask check — running (and populating the compile
+            # store) under a topology the caller didn't request is
+            # the failure mode, not a convenience
+            raise ValueError(
+                "pass either mesh= or n_devices=, not both — "
+                f"mesh spans {mesh.devices.size} device(s) while "
+                f"n_devices={n_devices} asks for its own"
+            )
+        # the R front-end's n.devices pass-through (and the python
+        # shorthand): a 1-D mesh over the first n_devices local
+        # devices, built by the one sanctioned constructor
+        # (executor.make_mesh, smklint SMK112)
+        mesh = make_mesh(n_devices, axis=cfg.mesh_axis)
     run_log = None
     # truthiness, not `is not None`: an empty-string run_log_dir must
     # mean "off" here exactly as it does in the executor wrapper —
@@ -414,6 +444,14 @@ def _fit_meta_kriging_impl(
         device_sync(beta_init)
 
     model = SpatialGPSampler(cfg, weight=weight)
+    # an explicit mesh implies sharded execution, with or without the
+    # sharded flag; resolved ONCE here because the mesh now scopes the
+    # whole pipeline — subset fits, failure-domain attribution, the
+    # on-device combine, and the sharded prediction composition
+    # (ISSUE 12) all see the same topology
+    run_mesh = mesh
+    if sharded and run_mesh is None:
+        run_mesh = make_mesh(axis=cfg.mesh_axis)
     with phase_timer(times, "subset_fits", log=run_log):
         if (
             checkpoint_path is not None
@@ -434,11 +472,6 @@ def _fit_meta_kriging_impl(
         ):
             from smk_tpu.parallel.recovery import fit_subsets_chunked
 
-            # an explicit mesh implies sharded execution, with or
-            # without the sharded flag (both branches agree on this)
-            run_mesh = mesh
-            if sharded and run_mesh is None:
-                run_mesh = make_mesh(axis=cfg.mesh_axis)
             results = fit_subsets_chunked(
                 model, part, coords_test, x_test, k_fit, beta_init,
                 chunk_iters=chunk_iters or checkpoint_every,
@@ -449,10 +482,10 @@ def _fit_meta_kriging_impl(
                 nan_guard=nan_guard,
                 pipeline_stats=pipeline_stats,
             )
-        elif sharded or mesh is not None:
+        elif run_mesh is not None:
             results = fit_subsets_sharded(
                 model, part, coords_test, x_test, k_fit, beta_init,
-                mesh=mesh, chunk_size=chunk_size,
+                mesh=run_mesh, chunk_size=chunk_size,
             )
         else:
             results = fit_subsets_vmap(
@@ -487,11 +520,7 @@ def _fit_meta_kriging_impl(
         # enforced at host granularity (DomainSurvivalError when most
         # of the machines are gone) and the dropped DOMAINS — those
         # that lost every subset — are named in the result
-        dmap = FailureDomainMap.derive(
-            cfg.n_subsets,
-            mesh if mesh is not None
-            else (make_mesh(axis=cfg.mesh_axis) if sharded else None),
-        )
+        dmap = FailureDomainMap.derive(cfg.n_subsets, run_mesh)
         domain_of_subset = np.asarray(dmap.domain_of_subset, int)
         domains_dropped = tuple(
             int(d) for d in range(dmap.n_domains)
@@ -499,15 +528,35 @@ def _fit_meta_kriging_impl(
         )
 
     with phase_timer(times, "combine", log=run_log):
+        grids_par, grids_w = results.param_grid, results.w_grid
+        if run_mesh is not None:
+            # on-device all-gather along the subsets axis (ISSUE 12):
+            # the K-sharded grid stacks are replicated across the
+            # mesh — ICI data movement, bitwise lossless, its own
+            # span so the run-log wall decomposition shows where the
+            # collective went
+            from smk_tpu.parallel.combine import gather_grids
+
+            import contextlib as _ctx
+
+            gspan = (
+                run_log.span("gather", n_subsets=cfg.n_subsets)
+                if run_log is not None
+                else _ctx.nullcontext()
+            )
+            with gspan:
+                grids_par = gather_grids(grids_par, run_mesh)
+                grids_w = gather_grids(grids_w, run_mesh)
+                device_sync((grids_par, grids_w))
         param_grid = combine_quantile_grids(
-            results.param_grid, cfg.combiner,
+            grids_par, cfg.combiner,
             n_iter=cfg.weiszfeld_iters, eps=cfg.weiszfeld_eps,
             survival_mask=survival_mask,
             min_surviving_frac=cfg.min_surviving_frac,
             domain_of_subset=domain_of_subset,
         )
         w_grid = combine_quantile_grids(
-            results.w_grid, cfg.combiner,
+            grids_w, cfg.combiner,
             n_iter=cfg.weiszfeld_iters, eps=cfg.weiszfeld_eps,
             survival_mask=survival_mask,
             min_surviving_frac=cfg.min_surviving_frac,
@@ -521,9 +570,43 @@ def _fit_meta_kriging_impl(
         sample_par, sample_w = inverse_cdf_resample(
             k_resample, [dense_par, dense_w], cfg.resample_size
         )
+        if (
+            run_mesh is not None
+            and cfg.resample_size % run_mesh.devices.size == 0
+        ):
+            # sharded prediction composition (ISSUE 12): the S
+            # resampled draws are embarrassingly parallel — lay them
+            # out row-sharded over the mesh
+            # (parallel/sharded_chol.row_sharding: rows over the
+            # subsets axis, columns replicated) so the S x t x q
+            # link-probability einsum partitions with zero
+            # communication; the draws were replicated post-combine,
+            # so the reshard is a local slice. Eager ops on the
+            # committed inputs dispatch the same modules as the host
+            # path — bit-identical, 1 device or 8.
+            from smk_tpu.parallel.sharded_chol import row_sharding
+
+            row = row_sharding(run_mesh)
+            sample_par = jax.device_put(sample_par, row)
+            sample_w = jax.device_put(sample_w, row)
+        x_test_p = x_test
+        if run_mesh is not None:
+            # the shared test designs replicate (every draw's
+            # probability needs every site — same layout as the
+            # executor's coords_test/x_test placement)
+            from smk_tpu.parallel.combine import replicate_to_mesh
+
+            x_test_p = replicate_to_mesh(x_test, run_mesh)
         p_samples = predict_probability(
-            sample_par, sample_w, x_test, link=cfg.link
+            sample_par, sample_w, x_test_p, link=cfg.link
         )
+        if run_mesh is not None:
+            # all-gather the per-draw probabilities back to
+            # replicated before the quantile summaries (which reduce
+            # over the sharded S axis) — pure data movement again
+            p_samples, sample_par, sample_w = replicate_to_mesh(
+                (p_samples, sample_par, sample_w), run_mesh
+            )
         param_quant = credible_summary(sample_par)
         w_quant = credible_summary(sample_w)
         p_quant = credible_summary(p_samples)

@@ -27,9 +27,15 @@ from smk_tpu.compile.programs import (
     config_digest,
     get_program,
     store_from_config,
+    topology_fingerprint,
 )
 from smk_tpu.compile.store import ProgramStore, env_fingerprint
-from smk_tpu.compile.warmup import chunk_plan_lengths, precompile
+from smk_tpu.compile.warmup import (
+    MeshSpecError,
+    chunk_plan_lengths,
+    mesh_from_spec,
+    precompile,
+)
 from smk_tpu.compile.xla_cache import (
     default_cache_dir,
     enable_persistent_cache,
@@ -44,9 +50,12 @@ __all__ = [
     "config_digest",
     "get_program",
     "store_from_config",
+    "topology_fingerprint",
     "ProgramStore",
     "env_fingerprint",
     "chunk_plan_lengths",
+    "MeshSpecError",
+    "mesh_from_spec",
     "precompile",
     "default_cache_dir",
     "enable_persistent_cache",

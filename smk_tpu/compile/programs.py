@@ -16,7 +16,8 @@ compiled program of the chunked executor (ISSUE 8, ROADMAP item 3).
   backend compile may be served from disk by XLA itself.
 
 The bucket key is ``(kind, chunk_len, K, chunk_size, m, q, p, t, d,
-n_chains, J, cov_model, link, resolved-fused-build, config-digest)``
+n_chains, J, cov_model, link, resolved-fused-build, config-digest
+[, topology-fingerprint])``
 — kind and chunk_len lead so the chaos harness's lookup wrapper
 (smk_tpu/testing/faults.py) keeps identifying chunk programs by
 ``key[0]``/``key[1]``, and every data-derived dimension of the
@@ -26,6 +27,16 @@ them. The digest covers every remaining config field
 with the pipeline/fault/compile knobs normalized out (same rationale
 as the checkpoint run-identity hash: those knobs don't change the
 compiled program, so they must not fragment the store).
+
+Topology-aware keys (ISSUE 12): a run under an explicit
+``jax.sharding.Mesh`` appends :func:`topology_fingerprint` — (mesh
+axis sizes, axis names, device kind, process count, devices per
+process) — as the key's trailing component, so a partitioned
+executable (whose device assignment and GSPMD layout are baked in at
+compile time) is stored and served PER TOPOLOGY instead of bypassing
+the store, and can never be handed to a run on a different mesh (or
+to the unmeshed path, whose keys stay byte-identical to PR 8 — an
+existing store keeps serving them).
 
 Telemetry: every acquisition records ``(key, program_source,
 compile_s)`` into the caller's ``ChunkPipelineStats`` —
@@ -97,10 +108,45 @@ def config_digest(cfg) -> str:
     return hashlib.sha256(repr(neutral).encode()).hexdigest()[:12]
 
 
+def topology_fingerprint(mesh=None) -> Optional[tuple]:
+    """The topology component of a bucket key: None for the unmeshed
+    path (keys stay byte-identical to PR 8, so an existing store
+    keeps serving single-device runs), else a tuple of everything a
+    partitioned executable bakes in at compile time — mesh axis
+    sizes, axis names, device kind, process count, and devices per
+    process. Two processes agreeing on this fingerprint (e.g. every
+    host of one v5e-8 job, or tomorrow's identically-shaped
+    deployment) share artifacts; any drift — a different mesh shape,
+    a renamed axis, a different chip, more or fewer hosts — keys a
+    DIFFERENT bucket, so a store built on one topology can never
+    mis-serve another (the env fingerprint in compile/store.py
+    additionally guards the process-global device/process counts
+    with a warned miss)."""
+    if mesh is None:
+        return None
+    devs = list(mesh.devices.flat)
+    kinds = sorted({str(d.device_kind) for d in devs})
+    procs = {int(d.process_index) for d in devs}
+    n_procs = max(1, len(procs))
+    return (
+        "mesh",
+        tuple(int(s) for s in mesh.devices.shape),
+        tuple(str(a) for a in mesh.axis_names),
+        "|".join(kinds),
+        n_procs,
+        len(devs) // n_procs,
+    )
+
+
+def _with_topology(key: tuple, mesh) -> tuple:
+    topo = topology_fingerprint(mesh)
+    return key if topo is None else key + (topo,)
+
+
 def chunk_bucket_key(
     model, kind: str, length: int, k: int,
     chunk_size: Optional[int], m: int, q: int, p: int, t: int,
-    d: int,
+    d: int, mesh=None,
 ) -> tuple:
     """Shape-bucket key of one chunk program. ``kind`` in
     {"burn", "samp"}; ``length`` is the chunk's iteration count (the
@@ -110,34 +156,44 @@ def chunk_bucket_key(
     test locations ``t``, coordinate dim ``d`` — because the config
     digest cannot see them: a shared store serving two datasets that
     differ only in p or t must MISS, not hand back an executable
-    lowered for different avals."""
+    lowered for different avals. ``mesh`` appends the topology
+    fingerprint (trailing, so key[0]/key[1] stay kind/length — the
+    chaos harness contract)."""
     cov_model, link, fused, n_chains, j = model.program_bucket_fields()
-    return (
+    return _with_topology((
         kind, length, k, chunk_size, m, q, p, t, d, n_chains, j,
         cov_model, link, fused, config_digest(model.config),
-    )
+    ), mesh)
 
 
-def aux_bucket_key(model, kind: str, *shape_fields) -> tuple:
+def aux_bucket_key(model, kind: str, *shape_fields, mesh=None) -> tuple:
     """Bucket key of a non-chunk hot program (stats guard, finalize,
     refork): ``kind`` never collides with the chunk kinds, so the
-    chaos harness's chunk-program filter skips these."""
+    chaos harness's chunk-program filter skips these. ``mesh``
+    appends the topology fingerprint exactly as on chunk keys."""
     cov_model, link, fused, n_chains, j = model.program_bucket_fields()
-    return (
+    return _with_topology(
         (kind,) + tuple(shape_fields)
         + (n_chains, j, cov_model, link, fused,
-           config_digest(model.config))
+           config_digest(model.config)),
+        mesh,
     )
 
 
 def store_from_config(cfg, mesh=None) -> Optional[ProgramStore]:
     """The L2 store a run should consult: enabled by
-    ``cfg.compile_store_dir``, disabled under an explicit device mesh
-    (a serialized executable bakes in its device assignment; the
-    sharded path keeps L1/L3 — single-device AOT artifacts must not
-    be loaded into, or written from, a mesh-sharded run)."""
+    ``cfg.compile_store_dir``. An explicit device mesh no longer
+    disables the store (ISSUE 12 — the old escape made exactly the
+    multi-chip runs that matter most re-pay the cold-compile tax):
+    meshed programs are keyed per :func:`topology_fingerprint`, so
+    their partitioned executables live in their own buckets and the
+    fingerprint-mismatch → warned-MISS-and-rebuild contract keeps a
+    store built on one topology from ever mis-loading onto another.
+    ``mesh`` is accepted for call-site compatibility and to document
+    intent; it no longer gates anything."""
+    del mesh  # topology rides in the bucket keys now
     d = getattr(cfg, "compile_store_dir", None)
-    if not d or mesh is not None:
+    if not d:
         return None
     return ProgramStore(d)
 

@@ -44,8 +44,13 @@ _SUFFIX = ".smkprog"
 def env_fingerprint() -> dict:
     """Everything a serialized executable is only valid under: jax and
     jaxlib versions, backend platform, device kind, and topology
-    (device/process counts). Compared on every load; any drift makes
-    the artifact stale (rebuilt, never mis-loaded)."""
+    (global device count, process count, devices per process — the
+    last added for ISSUE 12's topology-aware store, where a
+    mesh-partitioned executable additionally carries its mesh shape
+    in the BUCKET key via programs.topology_fingerprint). Compared on
+    every load; any drift makes the artifact stale (rebuilt, never
+    mis-loaded) — a store built on a v5e-8 can never mis-load onto a
+    different topology."""
     import jax
 
     devs = jax.devices()
@@ -57,6 +62,7 @@ def env_fingerprint() -> dict:
         "device_kind": devs[0].device_kind,
         "n_devices": len(devs),
         "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
     }
 
 

@@ -15,6 +15,14 @@ backend compile into a disk load — but the trace/lowering work and the
 jax-level dispatch-cache miss are still paid, which is why L1 (the
 in-memory per-model program cache) and L2 (serialized executables,
 ``smk_tpu/compile/store.py``) sit in front of it.
+
+Topology note (ISSUE 12): L3 needs no topology fingerprint of its
+own — jax's cache key already folds in the compile options, which
+carry the device assignment and SPMD partition count, so a
+mesh-partitioned module and its single-device twin hash to different
+entries natively. The bucket-key fingerprint
+(``programs.topology_fingerprint``) exists for L1/L2, where WE are
+the keying authority.
 """
 
 from __future__ import annotations

@@ -423,10 +423,15 @@ class SMKConfig:
     #   mis-loaded). A reloaded executable is the same machine code,
     #   so its draws are bit-identical to the process that built it.
     #   Setting this implies chunked execution in fit_meta_kriging
-    #   (the bucket-keyed programs live there), and the store is
-    #   bypassed under an explicit device mesh (a serialized
-    #   executable bakes in its device assignment). Pair with
-    #   smk_tpu.compile.precompile to pay compile at build time.
+    #   (the bucket-keyed programs live there). Under an explicit
+    #   device mesh the store is TOPOLOGY-AWARE (ISSUE 12): bucket
+    #   keys carry the (mesh shape, axis names, device kind, process
+    #   count) fingerprint, so a partitioned executable — whose
+    #   device assignment is baked in at compile time — is stored
+    #   and served per topology, and a store built on one topology
+    #   warns-and-rebuilds (never mis-loads) on another. Pair with
+    #   smk_tpu.compile.precompile(mesh=...) to pay compile at build
+    #   time for the exact sharded executables.
     # - xla_cache_dir (L3): arms jax's persistent XLA compilation
     #   cache through the one shared helper
     #   (smk_tpu/compile/xla_cache.py — the same cache bench.py

@@ -155,6 +155,15 @@ def summarize(path: str) -> Dict[str, Any]:
     live = [e["attrs"] for e in _events_named(run, "live_diagnostics")]
     ckpt = [e["attrs"] for e in _events_named(run, "ckpt_write")]
     breakdown = chunk_breakdown(run)
+    span_walls: Dict[str, float] = {}
+    for s in run["spans"]:
+        span_walls[s["name"]] = span_walls.get(s["name"], 0.0) + (
+            s["t1"] - s["t0"]
+        )
+
+    def _span_wall(name: str) -> Optional[float]:
+        w = span_walls.get(name)
+        return None if w is None else round(w, 4)
     wall = root["t1"] - root["t0"] if root is not None else None
     if wall and wall > 0:
         breakdown["host_stall_frac"] = round(
@@ -184,6 +193,17 @@ def summarize(path: str) -> Dict[str, Any]:
                 sum(float(c.get("seconds", 0.0)) for c in ckpt), 4
             ),
             "bytes": sum(int(c.get("nbytes", 0)) for c in ckpt),
+        },
+        # ISSUE 12: the posterior-combination tail of the pipeline —
+        # the on-device all-gather (its own "gather" span under a
+        # mesh) plus the combine and resample/predict phase spans, so
+        # the wall decomposition of a meshed end-to-end fit shows
+        # where the post-sampling seconds went (gather_s is None on
+        # an unmeshed run, which never gathers)
+        "combine": {
+            "combine_s": _span_wall("combine"),
+            "gather_s": _span_wall("gather"),
+            "resample_predict_s": _span_wall("resample_predict"),
         },
         "faults": faults,
         # ISSUE 11: chunk-watchdog timeline — one "armed" record when
@@ -267,6 +287,19 @@ def main(argv: List[str]) -> int:
         )
         if ch.get("hbm_peak_bytes") is not None:
             print(f"hbm_peak_bytes: {ch['hbm_peak_bytes']}")
+    cb = summary["combine"]
+    if cb["combine_s"] is not None:
+        print(
+            f"\ncombine: {cb['combine_s']}s"
+            + (
+                f" (on-device gather {cb['gather_s']}s)"
+                if cb["gather_s"] is not None else ""
+            )
+            + (
+                f"  resample_predict: {cb['resample_predict_s']}s"
+                if cb["resample_predict_s"] is not None else ""
+            )
+        )
     if summary["watchdog"]["fired"]:
         print(
             f"\nwatchdog fired {len(summary['watchdog']['fired'])} "

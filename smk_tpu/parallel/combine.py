@@ -25,6 +25,20 @@ fewer than ``min_surviving_frac`` of the subsets survive — the
 Minsker-style median is robust to subset *outliers*, but a NaN curve
 is not an outlier, it is poison, and must be removed before the
 reduction.
+
+On-device sharded combine (ISSUE 12): a meshed fit's (K, n_q, d)
+grid stacks come home K-SHARDED over the mesh (the finalize
+program's out_shardings pin, parallel/recovery.py) — they should
+never round-trip through the host just to be averaged.
+:func:`gather_grids` replicates them across the mesh with one
+on-device all-gather along the subsets axis (pure data movement —
+bitwise lossless), and ``combine_quantile_grids(mesh=...)`` runs the
+SAME eager combiner op sequence on the mesh-committed result, which
+is what makes a 1-device-mesh combine BIT-identical to the host
+path (the ops dispatch the same modules; only the committed
+placement differs). Survival/domain masks apply exactly as on the
+host path — the static surviving-index gather runs on device, so
+the masked reduction is bit-identical too.
 """
 
 from __future__ import annotations
@@ -79,6 +93,38 @@ class DomainSurvivalError(SubsetSurvivalError):
             "fault_domain fields) or lower config.min_surviving_frac "
             "deliberately",
         )
+
+
+def gather_grids(
+    grids: jnp.ndarray, mesh, *, axis: Optional[str] = None
+) -> jnp.ndarray:
+    """On-device all-gather of a (K, ...) stack along the subsets
+    axis: the K-sharded grids a meshed finalize ships are replicated
+    across the mesh (`jax.device_put` to the fully-replicated
+    NamedSharding lowers to the resharding all-gather — ICI on a real
+    slice, never a host round trip), so every device holds the whole
+    stack and the combiner's tiny O(K * n_q * d) reduction runs
+    replicated on the mesh. Pure data movement: the gathered values
+    are bitwise the sharded ones. ``axis`` is accepted for symmetry
+    with the executor helpers; replication spans the whole mesh
+    regardless."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    del axis  # P() replicates over every mesh axis
+    return jax.device_put(grids, NamedSharding(mesh, P()))
+
+
+def replicate_to_mesh(tree, mesh):
+    """Commit an array pytree to the mesh, fully replicated — the
+    entry ticket for running the (tiny) combine/resample/predict
+    composition on-device under the mesh instead of on the host
+    default device. Bitwise lossless."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    repl = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, repl), tree
+    )
 
 
 def wasserstein_barycenter(grids: jnp.ndarray) -> jnp.ndarray:
@@ -215,6 +261,7 @@ def combine_quantile_grids(
     survival_mask: Optional[np.ndarray] = None,
     min_surviving_frac: float = 0.0,
     domain_of_subset=None,
+    mesh=None,
 ) -> jnp.ndarray:
     """Dispatch on the configured combiner.
 
@@ -225,7 +272,16 @@ def combine_quantile_grids(
     ``domain_of_subset`` (optional, (K,) ints) additionally enforces
     the floor at failure-domain granularity
     (:class:`DomainSurvivalError`).
+
+    ``mesh`` (optional, ISSUE 12): the grids stay device-resident —
+    :func:`gather_grids` all-gathers the K-sharded stack on the mesh
+    and the combiner (+ mask gather) runs on the mesh-committed
+    replicated result. Same eager op sequence as the host path, so a
+    1-device-mesh combine is BIT-identical to ``mesh=None`` —
+    survival/domain masks included.
     """
+    if mesh is not None:
+        grids = gather_grids(grids, mesh)
     if survival_mask is not None:
         grids = apply_survival_mask(
             grids, survival_mask,

@@ -351,9 +351,21 @@ def subset_device_assignment(k: int, mesh: Mesh) -> list:
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "subsets") -> Mesh:
-    """1-D device mesh over the subset axis (ICI on a real slice)."""
+    """1-D device mesh over the subset axis (ICI on a real slice).
+    An ``n_devices`` exceeding the visible device count is an error,
+    never a silent downgrade: a fit asked for 8 chips must not run
+    8x slower on 1 — and must not populate the compile store under
+    the wrong topology fingerprint (ISSUE 12)."""
     devs = jax.devices()
     if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(
+                f"make_mesh(n_devices={n_devices}) but only "
+                f"{len(devs)} device(s) are visible — initialize the "
+                "accelerator backend (or force virtual CPU devices "
+                "with --xla_force_host_platform_device_count) before "
+                "building the mesh"
+            )
         devs = devs[:n_devices]
     import numpy as np
 

@@ -224,7 +224,7 @@ def _held_clone(state):
     return jax.tree_util.tree_map(_clone_leaf, state)
 
 
-def _make_refork(n_chains: int):
+def _make_refork(n_chains: int, out_sharding=None):
     """Build the quarantine relaunch program: subsets in ``mask`` get
     their chunk-start state back with (a) a PRNG key forked by their
     attempt count (jax.random.fold_in — deterministic, so a chaos
@@ -232,7 +232,9 @@ def _make_refork(n_chains: int):
     adaptation compounds across attempts: each retry starts from the
     previously tightened held state). Everything else is held — the
     K-1 unmasked subsets pass through bit-identically, which is what
-    makes the replayed chunk reproduce their draws exactly."""
+    makes the replayed chunk reproduce their draws exactly.
+    ``out_sharding`` pins the relaunched carry's leading-K layout
+    under a mesh (same rationale as _make_chunk_fn)."""
 
     def fork_one(key, attempt):
         return jax.random.fold_in(key, attempt)
@@ -266,6 +268,8 @@ def _make_refork(n_chains: int):
         )
         return state._replace(key=new_key, phi_log_step=tightened)
 
+    if out_sharding is not None:
+        return jax.jit(refork, out_shardings=out_sharding)
     return jax.jit(refork)
 
 
@@ -412,13 +416,44 @@ def _fetch_draws_slice(param_draws, w_draws, filled):
         )
 
 
-def _make_chunk_fn(model, kind, length, k, chunk_size):
+def _make_chunk_fn(model, kind, length, k, chunk_size,
+                   out_sharding=None):
     """Compiled one-chunk program: vmap over the K axis (and, inside
     each subset, over the chain axis when config.n_chains > 1),
     optionally lax.map-chunked over K (``chunk_size`` bounds how many
     subsets are resident at once — the same memory lever as
     fit_subsets_vmap), the carried state donated (at north-star scale
-    the duplicated carry would OOM the chip)."""
+    the duplicated carry would OOM the chip).
+
+    ``out_sharding`` (ISSUE 12, meshed runs only): a NamedSharding
+    prefix pinning every output leaf's leading-K layout. Without it
+    GSPMD picks output shardings freely, so the carried state could
+    come back laid out differently than the canonical input sharding
+    the program was compiled against — the next dispatch would then
+    recompile (jit) or be rejected outright (a stored AOT
+    executable). The pin closes the carry loop: outputs are exactly
+    the shardings the next chunk's inputs were lowered with. The
+    unmeshed path passes None and is byte-identical to every prior
+    round.
+
+    Donation gating under a mesh: on donation-unsupported backends
+    (the CPU client) a DESERIALIZED multi-device executable with a
+    donated carry corrupts its state from its second dispatch —
+    measured on the forced-8-device CPU mesh: dispatch 1 bit-exact,
+    dispatch 2 diverges, NaN by the first sampling chunk — because
+    the jax-level "backend ignores donation" drop does not survive
+    the serialize round trip (single-device artifacts are unaffected:
+    AOT_COMPILE_r10's warm legs are donating AND bit-identical). So
+    meshed programs donate only where donation is real (TPU/GPU —
+    where the carry aliasing is the whole point at north-star
+    scale), exactly the executor.write_draws gating policy."""
+    from smk_tpu.parallel.executor import _backend_supports_donation
+
+    jit_kw = dict(donate_argnums=(1,))
+    if out_sharding is not None:
+        jit_kw["out_shardings"] = out_sharding
+        if not _backend_supports_donation():
+            del jit_kw["donate_argnums"]
     if kind == "burn":
         sub = lambda d, s, t: model.burn_chunk(d, s, t, length)
     else:
@@ -431,7 +466,7 @@ def _make_chunk_fn(model, kind, length, k, chunk_size):
         body = sub
     runner = jax.vmap(body, in_axes=(DATA_AXES, 0, None))
     if chunk_size is None:
-        return jax.jit(runner, donate_argnums=(1,))
+        return jax.jit(runner, **jit_kw)
     if k % chunk_size != 0:
         raise ValueError(f"chunk_size {chunk_size} must divide K={k}")
     n_chunks = k // chunk_size
@@ -455,7 +490,7 @@ def _make_chunk_fn(model, kind, length, k, chunk_size):
             lambda a: a.reshape((k,) + a.shape[2:]), out
         )
 
-    return jax.jit(chunked, donate_argnums=(1,))
+    return jax.jit(chunked, **jit_kw)
 
 
 # L1 of the AOT program store (smk_tpu/compile/programs.py): the PR 6
@@ -480,36 +515,43 @@ def _cached_program(model, key, build, **kw):
     return compile_programs.get_program(model, key, build, **kw)
 
 
-def _chunk_key(model, kind, length, k, chunk_size, m, q, p, t, d):
+def _chunk_key(model, kind, length, k, chunk_size, m, q, p, t, d,
+               mesh=None):
     """Bucket key of one chunk program — (kind, chunk_len, K,
     chunk_size, m, q, p, t, d, n_chains, J, cov_model, link,
-    fused_build, config digest). kind/length lead so the chaos
-    harness keeps identifying chunk programs by key[0]/key[1]; the
-    data-derived dims (m, q, p, t, d) are explicit because the
-    config digest cannot see them."""
+    fused_build, config digest[, topology]). kind/length lead so the
+    chaos harness keeps identifying chunk programs by key[0]/key[1];
+    the data-derived dims (m, q, p, t, d) are explicit because the
+    config digest cannot see them; an explicit mesh appends the
+    TRAILING topology fingerprint (ISSUE 12) so partitioned
+    executables key their own store buckets."""
     return compile_programs.chunk_bucket_key(
-        model, kind, length, k, chunk_size, m, q, p, t, d
+        model, kind, length, k, chunk_size, m, q, p, t, d, mesh=mesh
     )
 
 
-def _stats_key(model, k, m, q, p):
+def _stats_key(model, k, m, q, p, mesh=None):
     # the stats program's input is the carried state, whose leaf
     # avals are determined by (k, m, q, p) + the chain axis (in the
     # aux fields)
-    return compile_programs.aux_bucket_key(model, "stats", k, m, q, p)
-
-
-def _finalize_key(model, k, m, q, n_kept, d_par, d_w):
-    # d_par = n_params(q, p) covers p; d_w = t*q covers t
     return compile_programs.aux_bucket_key(
-        model, "finalize", k, m, q, n_kept, d_par, d_w
+        model, "stats", k, m, q, p, mesh=mesh
     )
 
 
-def _refork_key(model, k, m, q, p):
+def _finalize_key(model, k, m, q, n_kept, d_par, d_w, mesh=None):
+    # d_par = n_params(q, p) covers p; d_w = t*q covers t
+    return compile_programs.aux_bucket_key(
+        model, "finalize", k, m, q, n_kept, d_par, d_w, mesh=mesh
+    )
+
+
+def _refork_key(model, k, m, q, p, mesh=None):
     # state-shaped like the stats program: the relaunch must miss
     # (never mis-load) across datasets with different subset shapes
-    return compile_programs.aux_bucket_key(model, "refork", k, m, q, p)
+    return compile_programs.aux_bucket_key(
+        model, "refork", k, m, q, p, mesh=mesh
+    )
 
 
 def _read_segments(path, seg_base, n_segments, filled, dtype):
@@ -1129,12 +1171,20 @@ def _fit_subsets_chunked_impl(
         repl = NamedSharding(mesh, P())
 
         def put(tree, sharded_leading_k=True):
-            return jax.tree_util.tree_map(
-                lambda a: jax.device_put(
-                    a, shard if sharded_leading_k else repl
-                ),
-                tree,
-            )
+            def one(a):
+                s = shard if sharded_leading_k else repl
+                if is_key_leaf(a):
+                    # typed PRNG keys are PRNGKeyArray, not ArrayImpl
+                    # — multi-host device_put (which must route
+                    # through the global-array scatter) rejects them,
+                    # so lower to raw key data and re-wrap (the same
+                    # convention as HostSnapshot/_clone_leaf)
+                    return jax.random.wrap_key_data(
+                        jax.device_put(jax.random.key_data(a), s)
+                    )
+                return jax.device_put(a, s)
+
+            return jax.tree_util.tree_map(one, tree)
 
         data = data._replace(
             coords=put(data.coords), x=put(data.x), y=put(data.y),
@@ -1144,6 +1194,7 @@ def _fit_subsets_chunked_impl(
         )
         keys = put(keys)
     else:
+        shard = repl = None
         put = None
 
     # Shape-only template: the resume branch never needs the real init
@@ -1194,7 +1245,35 @@ def _fit_subsets_chunked_impl(
         [cfg.n_samples, cfg.n_burn_in, k, d_par, d_w, cfg.n_chains],
         np.int64,
     )
-    ident = _run_identity(cfg, key, data, beta_init)
+    # On a MULTI-PROCESS mesh (ISSUE 12) the run-identity fingerprint
+    # cannot be computed: it samples every data leaf to host, and the
+    # shards of a globally-sharded leaf are not all addressable from
+    # one process. The fingerprint exists only to guard checkpoints,
+    # so the checkpoint-free scale-out path skips it (single-host
+    # runs keep computing it unconditionally — the sanctioned
+    # `run_identity` D2H tag is part of the pinned transfer ledger of
+    # the chaos/obs protocols), and checkpointing itself is a typed
+    # unsupported error on a multi-process mesh instead of a deep
+    # non-addressable-fetch crash (the draw segments would need the
+    # same impossible host gather).
+    multi_process_mesh = mesh is not None and len(
+        {int(d.process_index) for d in mesh.devices.flat}
+    ) > 1
+    if multi_process_mesh and checkpoint_path is not None:
+        raise NotImplementedError(
+            "checkpointing under a multi-process mesh is not "
+            "supported: the per-boundary draw segments require "
+            "host-fetching globally-sharded accumulators whose "
+            "shards live on other hosts. Run the multi-host fit "
+            "without checkpoint_path (subset fits are share-nothing "
+            "— a failed run re-fans out), or checkpoint per-host "
+            "single-process runs."
+        )
+    ident = (
+        np.zeros(1, np.uint32)
+        if multi_process_mesh
+        else _run_identity(cfg, key, data, beta_init)
+    )
     like = {
         "state": init_like,
         "it": np.asarray([0], np.int64),
@@ -1397,17 +1476,41 @@ def _fit_subsets_chunked_impl(
         param_draws, w_draws = empty_draws()
         it = 0
         holes = []
+        if put is not None:
+            # canonical carried-state sharding (ISSUE 12): every leaf
+            # with its leading K axis over the mesh. Eager init leaves
+            # some leaves replicated (sharding propagation is not
+            # GSPMD-optimal — measured: the O(m^2) chol_r factor came
+            # back P() on an 8-device mesh, n_devices x its memory),
+            # and a stored executable's baked-in input shardings must
+            # agree with the live carry — one device_put here makes
+            # fresh-init, resume, and the AOT-lowered avals identical.
+            state = put(state)
+            param_draws = put(param_draws)
+            w_draws = put(w_draws)
 
-    # L2 program store (ISSUE 8): consulted BEFORE tracing — a store
-    # hit deserializes the executable and the chunk program never
-    # compiles in this process. Disabled under an explicit mesh
-    # (serialized executables bake in their device assignment).
+    # L2 program store (ISSUE 8, topology-aware since ISSUE 12):
+    # consulted BEFORE tracing — a store hit deserializes the
+    # executable and the chunk program never compiles in this
+    # process. Under an explicit mesh the bucket keys carry the
+    # topology fingerprint, so partitioned executables are stored and
+    # served per (mesh shape, axis names, device kind, process
+    # count) instead of bypassing the store.
     store = compile_programs.store_from_config(cfg, mesh)
     # lowering arguments for the AOT path: the chunk programs are
-    # lowered against the live data, the init-state avals, and the
-    # exact weak-int32 scalar aval dispatch() feeds at runtime
+    # lowered against the live data, the init-state avals — sharded
+    # avals under a mesh, matching the canonicalized carry exactly —
+    # and the exact weak-int32 scalar aval dispatch() feeds at runtime
+    init_like_lowered = init_like
+    if put is not None:
+        init_like_lowered = jax.tree_util.tree_map(
+            lambda s: jax.ShapeDtypeStruct(
+                s.shape, s.dtype, sharding=shard
+            ),
+            init_like,
+        )
     chunk_lower = (
-        (data, init_like, jax.device_put(0))
+        (data, init_like_lowered, jax.device_put(0))
         if store is not None
         else None
     )
@@ -1420,9 +1523,11 @@ def _fit_subsets_chunked_impl(
             model,
             _chunk_key(
                 model, kind, n, k, chunk_size, m, q, p, t_test,
-                d_coord,
+                d_coord, mesh=mesh,
             ),
-            lambda: _make_chunk_fn(model, kind, n, k, chunk_size),
+            lambda: _make_chunk_fn(
+                model, kind, n, k, chunk_size, out_sharding=shard
+            ),
             store=store, lower_args=chunk_lower, stats=pstats,
         )
 
@@ -1436,10 +1541,12 @@ def _fit_subsets_chunked_impl(
     # _chunk_stats jit, byte-identically)
     stats_fn = (
         _cached_program(
-            model, _stats_key(model, k, m, q, p),
+            model, _stats_key(model, k, m, q, p, mesh=mesh),
             lambda: _chunk_stats,
             store=store,
-            lower_args=(init_like,) if store is not None else None,
+            lower_args=(
+                (init_like_lowered,) if store is not None else None
+            ),
             stats=pstats,
         )
         if want_stats
@@ -1473,7 +1580,7 @@ def _fit_subsets_chunked_impl(
             return _cached_program(
                 model,
                 compile_programs.aux_bucket_key(
-                    model, "stream", length, k, d_par
+                    model, "stream", length, k, d_par, mesh=mesh
                 ),
                 lambda: jax.jit(
                     make_stream_update(n_half_stream, cfg.n_chains)
@@ -1484,7 +1591,7 @@ def _fit_subsets_chunked_impl(
         stream_stats_fn = _cached_program(
             model,
             compile_programs.aux_bucket_key(
-                model, "stream_stats", k, d_par
+                model, "stream_stats", k, d_par, mesh=mesh
             ),
             lambda: jax.jit(make_stream_stats(cfg.n_chains)),
             stats=pstats,
@@ -1639,18 +1746,26 @@ def _fit_subsets_chunked_impl(
     t_loop0 = monotonic()
     refork = (
         _cached_program(
-            model, _refork_key(model, k, m, q, p),
-            lambda: _make_refork(cfg.n_chains),
+            model, _refork_key(model, k, m, q, p, mesh=mesh),
+            lambda: _make_refork(cfg.n_chains, out_sharding=shard),
             store=store,
             # the quarantine relaunch must reuse the stored program:
             # a disk-warm model's FIRST fault would otherwise compile
             # the refork on the retry critical path
-            # (tests/test_compile_store.py pins zero compiles there)
+            # (tests/test_compile_store.py pins zero compiles there).
+            # Under a mesh the retry masks lower REPLICATED — the
+            # same shardings apply_rewind feeds at runtime.
             lower_args=(
                 (
-                    init_like,
-                    jax.ShapeDtypeStruct((k,), np.bool_),
-                    jax.ShapeDtypeStruct((k,), np.int32),
+                    init_like_lowered,
+                    jax.ShapeDtypeStruct(
+                        (k,), np.bool_, sharding=repl
+                    ) if repl is not None
+                    else jax.ShapeDtypeStruct((k,), np.bool_),
+                    jax.ShapeDtypeStruct(
+                        (k,), np.int32, sharding=repl
+                    ) if repl is not None
+                    else jax.ShapeDtypeStruct((k,), np.int32),
                 )
                 if store is not None
                 else None
@@ -2074,11 +2189,18 @@ def _fit_subsets_chunked_impl(
             # successor's) — jax arrays are immutable, so the
             # boundary's pre-update reference IS the rewound state
             stream = b.get("stream_prev", stream)
-        state = refork(
-            b["held"],
-            jnp.asarray(rw.retry_mask),
-            jnp.asarray(attempts, jnp.int32),
-        )
+        mask_dev = jnp.asarray(rw.retry_mask)
+        att_dev = jnp.asarray(attempts, jnp.int32)
+        if repl is not None:
+            # match the stored/lowered refork executable's replicated
+            # mask avals (a committed mismatched array would be
+            # rejected by the AOT calling convention)
+            mask_dev = jax.device_put(mask_dev, repl)
+            att_dev = jax.device_put(att_dev, repl)
+        # the refork's out_shardings pin means the relaunched carry
+        # presents the exact leading-K shardings the (possibly
+        # stored) chunk executable was compiled against
+        state = refork(b["held"], mask_dev, att_dev)
         if b["phase"] != "fill":
             it = b["start"]
 
@@ -2204,11 +2326,25 @@ def _fit_subsets_chunked_impl(
     )
     with fin_span:
         finalize = _cached_program(
-            model, _finalize_key(model, k, m, q, n_kept, d_par, d_w),
-            lambda: jax.jit(jax.vmap(model.finalize)),
+            model,
+            _finalize_key(
+                model, k, m, q, n_kept, d_par, d_w, mesh=mesh
+            ),
+            # under a mesh the compressed per-subset posteriors come
+            # back canonically K-sharded (out_shardings pin) — the
+            # on-device combine (parallel/combine.py) consumes them
+            # without ever leaving the mesh
+            lambda: (
+                jax.jit(jax.vmap(model.finalize), out_shardings=shard)
+                if shard is not None
+                else jax.jit(jax.vmap(model.finalize))
+            ),
             store=store,
+            # the draw accumulators are live (canonically sharded
+            # under a mesh), so lowering against them captures the
+            # exact runtime shardings
             lower_args=(
-                (init_like, param_draws, w_draws)
+                (init_like_lowered, param_draws, w_draws)
                 if store is not None
                 else None
             ),

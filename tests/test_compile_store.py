@@ -81,7 +81,12 @@ class TestProgramStore:
         )
 
     @pytest.mark.parametrize(
-        "field", ["jaxlib", "device_kind", "backend", "n_devices"]
+        "field", ["jaxlib", "device_kind", "backend", "n_devices",
+                  # ISSUE 12: the process topology is part of the
+                  # environment a serialized executable is valid
+                  # under — a store written by an 8-host job must
+                  # warn-and-rebuild on a 4-host one, never mis-load
+                  "process_count", "local_device_count"]
     )
     def test_stale_fingerprint_is_a_warned_miss(
         self, tmp_path, monkeypatch, field
@@ -91,7 +96,11 @@ class TestProgramStore:
         store.save(key, _toy_compiled())
         real = env_fingerprint()
         fake = dict(real)
-        fake[field] = "perturbed" if field != "n_devices" else 999
+        fake[field] = (
+            "perturbed"
+            if field in ("jaxlib", "device_kind", "backend")
+            else 999
+        )
         monkeypatch.setattr(
             store_mod, "env_fingerprint", lambda: fake
         )
@@ -273,9 +282,10 @@ class TestBucketKeys:
         assert store_from_config(SMKConfig()) is None
         cfg = SMKConfig(compile_store_dir=str(tmp_path))
         assert store_from_config(cfg) is not None
-        # a serialized executable bakes in its device assignment:
-        # bypassed under an explicit mesh
-        assert store_from_config(cfg, mesh=object()) is None
+        # ISSUE 12 regression: an explicit mesh no longer bypasses
+        # the store — meshed programs key their own topology buckets
+        # (tests/test_mesh_store.py pins the per-topology isolation)
+        assert store_from_config(cfg, mesh=object()) is not None
 
     def test_config_rejects_non_string_dirs(self):
         with pytest.raises(ValueError, match="compile_store_dir"):

@@ -72,22 +72,24 @@ def test_dryrun_multichip_reexec_path():
     assert "dryrun_multichip ok" in out.stdout
 
 
-@pytest.mark.slow
-def test_dryrun_multichip_never_inits_dead_backend():
-    """MULTICHIP_r05 regression (rc=124): with JAX_PLATFORMS naming a
-    non-CPU backend, the PARENT process used to initialize that
-    backend just to count devices — which blocks indefinitely on a
-    dead TPU tunnel. The parent must now skip the probe entirely and
-    go straight to the forced-CPU re-exec child. A nonexistent
-    backend name makes the old behavior fail fast (unknown backend
-    raises at init), so this passes iff the parent never touches its
-    own backend."""
+def test_dryrun_multichip_exotic_platform_typed_skip():
+    """MULTICHIP_r05 regression, second act (ISSUE 12): the dead
+    failure mode was rc=124 with only a "Platform 'axon' is
+    experimental" warning in the tail — the probe's CHILD hung at
+    `import jax` when the experimental plugin's dead transport
+    blocked registration. An experimental/unsupported JAX_PLATFORMS
+    is now classified UP FRONT (no jax import, no subprocess) and
+    the record is one typed {"skipped": true, "reason": ...} JSON
+    line, never a timeout corpse. The subprocess leg proves the
+    whole thing completes in seconds with rc=0."""
+    import json
+
     env = {
         k: v
         for k, v in os.environ.items()
         if k not in ("XLA_FLAGS", "_SMK_DRYRUN_CHILD")
     }
-    env["JAX_PLATFORMS"] = "no_such_backend"
+    env["JAX_PLATFORMS"] = "axon"
     code = (
         "import sys; sys.path.insert(0, sys.argv[1]); "
         "from __graft_entry__ import dryrun_multichip; "
@@ -98,7 +100,24 @@ def test_dryrun_multichip_never_inits_dead_backend():
         env=env,
         capture_output=True,
         text=True,
-        timeout=540,
+        timeout=120,
     )
     assert out.returncode == 0, (out.stdout[-1000:], out.stderr[-3000:])
-    assert "dryrun_multichip ok" in out.stdout
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["skipped"] is True
+    assert "axon" in rec["reason"]
+    assert "dryrun_multichip ok" not in out.stdout
+
+
+def test_classify_dryrun_platform():
+    from __graft_entry__ import classify_dryrun_platform
+
+    # supported spellings never skip (empty = auto-detect stays live)
+    for ok in ("", "cpu", "tpu", "cpu,tpu", " CPU "):
+        assert classify_dryrun_platform(ok) is None, ok
+    # experimental/unknown platforms are named in the reason
+    reason = classify_dryrun_platform("axon")
+    assert reason is not None and "axon" in reason
+    # a mixed list is still a skip: the exotic plugin registers (and
+    # can hang) regardless of which platform wins resolution
+    assert classify_dryrun_platform("axon,cpu") is not None

@@ -1,0 +1,482 @@
+"""Topology-aware compile store + on-device sharded combine tests
+(ISSUE 12, smk_tpu/compile/ + parallel/{recovery,combine}.py).
+
+The conftest forces 8 virtual CPU devices, so every leg here runs the
+REAL mesh machinery without TPU hardware. Contracts under test:
+
+- topology fingerprint units: unmeshed keys are byte-identical to the
+  PR 8 form (an existing store keeps serving), meshed keys append the
+  (mesh shape, axis names, device kind, process count, devices per
+  process) fingerprint — perturbing any component keys a DIFFERENT
+  bucket, so a store can never mis-serve across topologies; the chaos
+  harness's key[0]/key[1] = kind/length contract survives;
+- the warm meshed world (module fixture, ONE program-set build):
+  ``precompile(mesh_spec=...)`` AOT-builds the sharded executables
+  into an empty store with no fit; a FRESH MODEL's meshed fit then
+  serves every program from L2, a second fresh-model fit holds under
+  ``recompile_guard(max_compiles=0)`` — the old `mesh -> store
+  bypassed` escape is gone, regression-pinned — and both fits are
+  bit-identical;
+- store isolation: the mesh-warm store serves NOTHING to unmeshed or
+  differently-meshed keys (checked at the store level — no second
+  program-set build in the gate);
+- mesh-vs-vmap draw parity (slow: extra program sets): a 1-DEVICE
+  mesh is bit-identical to the plain vmap executor; the 8-device
+  partitioned programs are deterministic run-to-run and match vmap to
+  fp-reassociation tolerance (measured ~5e-6 — GSPMD partitioning
+  changes the module context, the same reason the PR 5 stats program
+  lives outside the chunk module; bit-identity across an 8-way
+  partition boundary is not a property XLA:CPU offers).
+"""
+
+# smklint: test-budget=one m=16 meshed program set shared via the module fixture (~15 s); everything re-paying a program set is slow-marked
+
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from smk_tpu.analysis.sanitizers import recompile_guard
+from smk_tpu.compile import (
+    MeshSpecError,
+    ProgramStore,
+    mesh_from_spec,
+    precompile,
+    topology_fingerprint,
+)
+from smk_tpu.config import SMKConfig
+from smk_tpu.models.probit_gp import SpatialProbitGP
+from smk_tpu.parallel.executor import make_mesh
+from smk_tpu.parallel.partition import random_partition
+from smk_tpu.parallel.recovery import _chunk_key, fit_subsets_chunked
+from smk_tpu.utils.tracing import ChunkPipelineStats
+
+needs_8 = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs 8 virtual devices"
+)
+
+N, K, Q, P_DIM, T = 128, 8, 1, 2, 8
+N_SAMPLES, CHUNK = 16, 4
+
+
+# ---------------------------------------------------------------------------
+# fingerprint / key units (no compiles)
+# ---------------------------------------------------------------------------
+
+
+class TestTopologyFingerprint:
+    def test_none_without_mesh_and_pr8_key_shape(self):
+        assert topology_fingerprint(None) is None
+        model = SpatialProbitGP(SMKConfig(), weight=1)
+        key = _chunk_key(model, "samp", 250, 32, None, 3906, 1, 2,
+                         64, 2)
+        # unmeshed keys end with the config digest — byte-identical
+        # to the PR 8 layout, so an existing store keeps serving
+        assert isinstance(key[-1], str) and len(key[-1]) == 12
+        assert key == _chunk_key(
+            model, "samp", 250, 32, None, 3906, 1, 2, 64, 2,
+            mesh=None,
+        )
+
+    @needs_8
+    def test_fingerprint_fields(self):
+        mesh = make_mesh(8)
+        topo = topology_fingerprint(mesh)
+        assert topo[0] == "mesh"
+        assert topo[1] == (8,)          # axis sizes
+        assert topo[2] == ("subsets",)  # axis names
+        assert isinstance(topo[3], str) and topo[3]  # device kind
+        assert topo[4] == jax.process_count()
+        assert topo[5] == 8 // jax.process_count()
+
+    @needs_8
+    def test_each_perturbation_keys_a_different_bucket(self):
+        model = SpatialProbitGP(SMKConfig(), weight=1)
+
+        def key_for(mesh):
+            return _chunk_key(
+                model, "samp", 250, 32, None, 3906, 1, 2, 64, 2,
+                mesh=mesh,
+            )
+
+        base = key_for(make_mesh(8))
+        # chaos-harness contract survives the trailing fingerprint
+        assert base[0] == "samp" and base[1] == 250
+        # mesh vs no mesh
+        assert base != key_for(None)
+        # perturbed mesh shape
+        assert base != key_for(make_mesh(4))
+        # perturbed axis name
+        assert base != key_for(make_mesh(8, axis="replicas"))
+        # 1-device mesh vs no mesh (the degenerate isolation case)
+        assert key_for(make_mesh(1)) != key_for(None)
+        # a perturbed process count moves the fingerprint (the live
+        # jax.process_count() is 1 here, so simulate via the tuple)
+        topo = topology_fingerprint(make_mesh(8))
+        assert topo[4] == 1  # this suite is single-process
+        perturbed = topo[:4] + (2,) + topo[5:]
+        assert perturbed != topo
+
+    @needs_8
+    def test_mesh_from_spec(self):
+        kind = str(jax.devices()[0].device_kind)
+        mesh = mesh_from_spec((8,), kind)
+        assert tuple(int(s) for s in mesh.devices.shape) == (8,)
+        assert mesh.axis_names == ("subsets",)
+        # device-kind agnostic spec resolves too
+        assert mesh_from_spec((4,), None).devices.size == 4
+        # a 2-D spec is rejected (the K fan-out shards one axis)
+        with pytest.raises(MeshSpecError, match="1-D"):
+            mesh_from_spec((2, 4), kind)
+        # an unsatisfiable kind raises the typed error naming both
+        # resolution attempts
+        with pytest.raises(MeshSpecError, match="neither"):
+            mesh_from_spec((8,), "TPU v99")
+
+    def test_make_mesh_rejects_over_ask(self):
+        """Review regression: asking for more devices than are
+        visible must raise, never silently downgrade to a smaller
+        mesh — a fit asked for 8 chips must not run 8x slower on 1
+        AND populate the store under the wrong topology
+        fingerprint."""
+        with pytest.raises(ValueError, match="only"):
+            make_mesh(jax.device_count() + 1)
+
+    @needs_8
+    def test_api_rejects_conflicting_mesh_and_n_devices(self):
+        """Review regression: mesh= and n_devices= together must
+        raise (the same no-silent-downgrade policy) instead of
+        quietly running — and keying the store — under whichever
+        one the implementation happened to prefer."""
+        from smk_tpu.api import fit_meta_kriging
+
+        rng = np.random.default_rng(0)
+        with pytest.raises(ValueError, match="not both"):
+            fit_meta_kriging(
+                jax.random.key(0),
+                rng.integers(0, 2, (16, 1)).astype(np.float32),
+                rng.normal(size=(16, 1, 2)).astype(np.float32),
+                rng.uniform(size=(16, 2)).astype(np.float32),
+                rng.uniform(size=(4, 2)).astype(np.float32),
+                rng.normal(size=(4, 1, 2)).astype(np.float32),
+                mesh=make_mesh(4), n_devices=8,
+            )
+
+    def test_precompile_passes_allow_topology_through(
+        self, problem, tmp_path
+    ):
+        """Review regression: the documented AOT-topology precompile
+        path must be reachable — precompile(mesh_spec=...,
+        allow_topology=...) forwards the opt-in to mesh_from_spec
+        (an unsatisfiable spec without the opt-in raises the typed
+        error NAMING allow_topology, proving the parameter exists
+        end to end; nothing compiles before the resolution)."""
+        part, ct, xt = problem
+        cfg = _cfg(str(tmp_path))
+        model = SpatialProbitGP(cfg, weight=1)
+        with pytest.raises(MeshSpecError, match="allow_topology"):
+            precompile(
+                model, part, ct, xt, chunk_iters=CHUNK,
+                mesh_spec=((8,), "TPU v99"), allow_topology=False,
+            )
+
+
+# ---------------------------------------------------------------------------
+# the warm meshed world (one shared program-set build)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def problem():
+    rng = np.random.default_rng(0)
+    coords = jnp.asarray(rng.uniform(size=(N, 2)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(N, Q, P_DIM)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, (N, Q)), jnp.float32)
+    ct = jnp.asarray(rng.uniform(size=(T, 2)), jnp.float32)
+    xt = jnp.asarray(rng.normal(size=(T, Q, P_DIM)), jnp.float32)
+    part = random_partition(jax.random.key(0), y, x, coords, K)
+    return part, ct, xt
+
+
+def _cfg(store_dir=None, **kw):
+    return SMKConfig(
+        n_subsets=K, n_samples=N_SAMPLES, burn_in_frac=0.5,
+        n_quantiles=8, compile_store_dir=store_dir, **kw,
+    )
+
+
+def _fit(cfg, problem, mesh=None, **kw):
+    part, ct, xt = problem
+    model = SpatialProbitGP(cfg, weight=1)
+    return model, fit_subsets_chunked(
+        model, part, ct, xt, jax.random.key(3),
+        chunk_iters=CHUNK, mesh=mesh, **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def mesh_warm_store(tmp_path_factory, problem):
+    """The module's one expensive build: an empty store populated by
+    a MESHED ``precompile`` (via the (shape, kind) spec — the
+    deployment warmup path), then two fresh-model meshed fits served
+    entirely from it, the second under recompile_guard(0)."""
+    if jax.device_count() < 8:
+        pytest.skip("needs 8 virtual devices")
+    part, ct, xt = problem
+    sd = str(tmp_path_factory.mktemp("mesh_store"))
+    cfg = _cfg(sd)
+    kind = str(jax.devices()[0].device_kind)
+    model = SpatialProbitGP(cfg, weight=1)
+    report = precompile(
+        model, part, ct, xt, chunk_iters=CHUNK,
+        mesh_spec=((8,), kind),
+    )
+    mesh = make_mesh(8)
+    ps1 = ChunkPipelineStats()
+    _, res1 = _fit(cfg, problem, mesh=mesh, pipeline_stats=ps1)
+    ps2 = ChunkPipelineStats()
+    with recompile_guard(0, "mesh-store-warm fit") as g:
+        _, res2 = _fit(cfg, problem, mesh=mesh, pipeline_stats=ps2)
+    return dict(
+        store=sd, report=report, res1=res1, res2=res2, ps1=ps1,
+        ps2=ps2, compiles=g.compiles, mesh=mesh,
+    )
+
+
+class TestMeshWarmStore:
+    def test_meshed_precompile_populates_store(self, mesh_warm_store):
+        w = mesh_warm_store
+        # burn4 + samp4 + stats + finalize, all AOT, all persisted
+        assert w["report"]["n_programs"] == 4
+        assert w["report"]["topology"] == {
+            "mesh_shape": (8,), "axis_names": ("subsets",),
+        }
+        assert len([
+            f for f in os.listdir(w["store"])
+            if f.endswith(".smkprog")
+        ]) == 4
+        assert all(p["aot"] for p in w["report"]["programs"])
+
+    def test_store_warm_meshed_fit_all_l2_zero_compiles(
+        self, mesh_warm_store
+    ):
+        """THE ISSUE 12 acceptance pin: a store-warm fresh model
+        running under an explicit mesh performs ZERO XLA backend
+        compiles and serves every program from L2 — the old
+        `mesh is not None -> store bypassed` escape is gone."""
+        w = mesh_warm_store
+        assert {p["source"] for p in w["ps1"].programs} == {"l2"}
+        assert {p["source"] for p in w["ps2"].programs} <= {
+            "l1", "l2"
+        }
+        assert w["compiles"] == 0
+
+    def test_store_warm_meshed_draws_bit_identical(
+        self, mesh_warm_store
+    ):
+        w = mesh_warm_store
+        np.testing.assert_array_equal(
+            np.asarray(w["res1"].param_grid),
+            np.asarray(w["res2"].param_grid),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(w["res1"].param_samples),
+            np.asarray(w["res2"].param_samples),
+        )
+
+    def test_mesh_warm_store_isolated_from_other_topologies(
+        self, mesh_warm_store, problem
+    ):
+        """The 8-device artifacts must be INVISIBLE to unmeshed,
+        1-device-mesh, and differently-shaped-mesh lookups — checked
+        at the store level (no second program-set build in the
+        tier-1 gate; the fit-level leg is the slow sibling)."""
+        w = mesh_warm_store
+        part, _, _ = problem
+        store = ProgramStore(w["store"])
+        model = SpatialProbitGP(_cfg(w["store"]), weight=1)
+        m = part.x.shape[1]
+
+        def key_for(mesh):
+            return _chunk_key(
+                model, "burn", CHUNK, K, None, m, Q, P_DIM, T, 2,
+                mesh=mesh,
+            )
+
+        assert store.load(key_for(make_mesh(8))) is not None
+        for other in (None, make_mesh(1), make_mesh(4),
+                      make_mesh(8, axis="replicas")):
+            assert store.load(key_for(other)) is None
+
+    def test_grids_come_home_sharded(self, mesh_warm_store):
+        """On-device combine precondition: the meshed finalize ships
+        the (K, n_q, d) grids K-sharded over the mesh (the
+        out_shardings pin), so the combine's all-gather is a device
+        collective, never a host round trip."""
+        w = mesh_warm_store
+        sharding = w["res1"].param_grid.sharding
+        assert getattr(sharding, "mesh", None) is not None
+        assert not sharding.is_fully_replicated
+
+
+# ---------------------------------------------------------------------------
+# on-device combine parity (no program-set builds — eager ops only)
+# ---------------------------------------------------------------------------
+
+
+class TestShardedCombine:
+    @needs_8
+    def test_gather_and_combine_bit_identical_to_host(
+        self, mesh_warm_store
+    ):
+        from smk_tpu.parallel.combine import (
+            combine_quantile_grids,
+            gather_grids,
+        )
+
+        grids = mesh_warm_store["res1"].param_grid  # K-sharded
+        host = combine_quantile_grids(
+            jnp.asarray(np.asarray(grids)), "wasserstein_mean"
+        )
+        mesh = mesh_warm_store["mesh"]
+        on_dev = combine_quantile_grids(
+            grids, "wasserstein_mean", mesh=mesh
+        )
+        np.testing.assert_array_equal(
+            np.asarray(host), np.asarray(on_dev)
+        )
+        # the weiszfeld median and a masked (degraded) combine too
+        mask = np.ones(K, bool)
+        mask[2] = False
+        for method in ("wasserstein_mean", "weiszfeld_median"):
+            a = combine_quantile_grids(
+                jnp.asarray(np.asarray(grids)), method,
+                survival_mask=mask,
+            )
+            b = combine_quantile_grids(
+                gather_grids(grids, mesh), method,
+                survival_mask=mask,
+            )
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            )
+
+    @needs_8
+    def test_survival_floor_still_enforced_on_device(
+        self, mesh_warm_store
+    ):
+        from smk_tpu.parallel.combine import (
+            SubsetSurvivalError,
+            combine_quantile_grids,
+        )
+
+        grids = mesh_warm_store["res1"].param_grid
+        mask = np.zeros(K, bool)
+        mask[0] = True
+        with pytest.raises(SubsetSurvivalError):
+            combine_quantile_grids(
+                grids, "wasserstein_mean", survival_mask=mask,
+                min_surviving_frac=0.5,
+                mesh=mesh_warm_store["mesh"],
+            )
+
+
+# ---------------------------------------------------------------------------
+# mesh-vs-vmap parity (slow: each leg re-pays a program set)
+# ---------------------------------------------------------------------------
+
+
+class TestMeshVsVmap:
+    @pytest.mark.slow  # compiles the UNMESHED + 1-device-mesh program sets (~20 s) beyond the module fixture's
+    @needs_8
+    def test_one_device_mesh_bit_identical_and_8dev_tolerance(
+        self, mesh_warm_store, problem
+    ):
+        """The honest parity matrix on XLA:CPU: a 1-device mesh is
+        BIT-identical to the plain vmap executor (trivial
+        partitioning — same modules); 8-device partitioned programs
+        are deterministic (rerun bit-identical, pinned by the warm
+        fixture) and match vmap to fp-reassociation tolerance only
+        (measured ~5e-6: GSPMD changes the module context, the PR 5
+        module-context caveat)."""
+        _, res_vmap = _fit(_cfg(None), problem)
+        _, res_m1 = _fit(_cfg(None), problem, mesh=make_mesh(1))
+        np.testing.assert_array_equal(
+            np.asarray(res_vmap.param_grid),
+            np.asarray(res_m1.param_grid),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(res_vmap.param_samples),
+            np.asarray(res_m1.param_samples),
+        )
+        res_m8 = mesh_warm_store["res1"]
+        np.testing.assert_allclose(
+            np.asarray(res_vmap.param_grid),
+            np.asarray(res_m8.param_grid),
+            rtol=2e-4, atol=2e-4,
+        )
+
+    @pytest.mark.slow  # full api pipeline twice (~25 s): the probe's subprocess leg is the protocol record
+    @needs_8
+    def test_api_pipeline_1dev_mesh_bit_identical(self):
+        """Acceptance criterion 4 in-repo: meshed fit→combine→predict
+        on a 1-device mesh is bit-identical to the host path, every
+        result field (the on-device gather + row-sharded predict are
+        the same math, not a lookalike)."""
+        from smk_tpu.api import fit_meta_kriging
+
+        rng = np.random.default_rng(1)
+        y = rng.integers(0, 2, (N, Q)).astype(np.float32)
+        x = rng.normal(size=(N, Q, P_DIM)).astype(np.float32)
+        coords = rng.uniform(size=(N, 2)).astype(np.float32)
+        ct = rng.uniform(size=(T, 2)).astype(np.float32)
+        xt = rng.normal(size=(T, Q, P_DIM)).astype(np.float32)
+        cfg = SMKConfig(
+            n_subsets=K, n_samples=N_SAMPLES, burn_in_frac=0.5,
+            n_quantiles=8, resample_size=40,
+        )
+        host = fit_meta_kriging(
+            jax.random.key(0), y, x, coords, ct, xt, config=cfg,
+            chunk_iters=CHUNK,
+        )
+        meshed = fit_meta_kriging(
+            jax.random.key(0), y, x, coords, ct, xt, config=cfg,
+            chunk_iters=CHUNK, n_devices=1,
+        )
+        for f in ("param_grid", "w_grid", "sample_par", "sample_w",
+                  "p_samples", "param_quant", "w_quant", "p_quant"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(host, f)),
+                np.asarray(getattr(meshed, f)),
+                err_msg=f,
+            )
+
+    @pytest.mark.slow  # quarantine retry under the mesh re-pays the refork/injector programs
+    @needs_8
+    def test_quarantine_retry_on_mesh_warm_store(
+        self, mesh_warm_store, problem
+    ):
+        """Fault-isolation interplay under a mesh: an injected-NaN
+        retry on the mesh-warm store keeps the healthy K-1 subsets
+        bit-identical to the fault-free meshed reference."""
+        from smk_tpu.testing.faults import inject_subset_nan
+
+        w = mesh_warm_store
+        qcfg = _cfg(w["store"], fault_policy="quarantine")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            with inject_subset_nan(1, at_iteration=10):
+                _, res = _fit(
+                    qcfg, problem, mesh=w["mesh"],
+                )
+        ref = w["res1"]
+        for j in range(K):
+            if j == 1:
+                continue
+            np.testing.assert_array_equal(
+                np.asarray(res.param_grid[j]),
+                np.asarray(ref.param_grid[j]),
+            )

@@ -814,6 +814,117 @@ class TestUnboundedWait:
         assert "SMK111" in rules_hit(broken, path=real)
 
 
+class TestMeshHygiene:
+    """SMK112 (ISSUE 12): direct Mesh(...) construction in smk_tpu/
+    library code outside parallel/executor.py — executor.make_mesh
+    is the one source of truth, keeping the compile store's topology
+    fingerprints and the failure-domain layout oracle honest."""
+
+    def test_from_import_spelling_flagged(self):
+        src = (
+            "import numpy as np\n"
+            "from jax.sharding import Mesh\n"
+            "def f(devs):\n"
+            "    return Mesh(np.array(devs), ('subsets',))\n"
+        )
+        assert "SMK112" in rules_hit(src)
+
+    def test_aliased_from_import_flagged(self):
+        src = (
+            "import numpy as np\n"
+            "from jax.sharding import Mesh as M\n"
+            "def f(devs):\n"
+            "    return M(np.array(devs), ('x',))\n"
+        )
+        assert "SMK112" in rules_hit(src)
+
+    def test_attribute_spellings_flagged(self):
+        for call in (
+            "jax.sharding.Mesh(np.array(devs), ('subsets',))",
+            "sharding.Mesh(np.array(devs), ('subsets',))",
+        ):
+            src = (
+                "import jax\nimport numpy as np\n"
+                "from jax import sharding\n"
+                f"def f(devs):\n    return {call}\n"
+            )
+            assert "SMK112" in rules_hit(src), call
+
+    def test_make_mesh_and_annotations_clean(self):
+        # the sanctioned path, plus Mesh as a TYPE (annotation /
+        # isinstance) — only construction is a finding
+        src = (
+            "from jax.sharding import Mesh\n"
+            "from smk_tpu.parallel.executor import make_mesh\n"
+            "def f(n) -> Mesh:\n"
+            "    m = make_mesh(n)\n"
+            "    assert isinstance(m, Mesh)\n"
+            "    return m\n"
+        )
+        assert "SMK112" not in rules_hit(src)
+        # an unrelated local Mesh is not jax's
+        local = (
+            "class Mesh:\n    pass\n"
+            "def f():\n    return Mesh()\n"
+        )
+        assert "SMK112" not in rules_hit(local)
+
+    def test_scope(self):
+        src = (
+            "import numpy as np\n"
+            "from jax.sharding import Mesh\n"
+            "def f(devs):\n"
+            "    return Mesh(np.array(devs), ('subsets',))\n"
+        )
+        # executor.py is the one sanctioned constructor site
+        assert "SMK112" not in rules_hit(
+            src, path="smk_tpu/parallel/executor.py"
+        )
+        # tests/scripts/bench are exempt (probe code builds ad-hoc
+        # meshes deliberately)
+        assert "SMK112" not in rules_hit(src, path=TESTS_PATH)
+        assert "SMK112" not in rules_hit(src, path=SCRIPT_PATH)
+        assert "SMK112" not in rules_hit(src, path="bench.py")
+        # the rest of smk_tpu/ is in scope
+        assert "SMK112" in rules_hit(
+            src, path="smk_tpu/parallel/domains.py"
+        )
+
+    def test_suppression_honored(self):
+        src = (
+            "from jax.sharding import Mesh\n"
+            "import numpy as np\n"
+            "def f(devs):\n"
+            "    # smklint: disable=SMK112 -- abstract AOT topology devices, no live make_mesh source\n"
+            "    return Mesh(np.array(devs), ('subsets',))\n"
+        )
+        assert "SMK112" not in rules_hit(src)
+
+    def test_real_combine_clean_and_seeded_defect_caught(self):
+        """Seeded defect on the REAL module: the on-device combine
+        takes the caller's mesh and must never roll its own — a
+        pasted ad-hoc Mesh construction is caught."""
+        real = "smk_tpu/parallel/combine.py"
+        src = repo_file(real)
+        assert "SMK112" not in rules_hit(src, path=real)
+        broken = src + (
+            "\nfrom jax.sharding import Mesh as _SneakyMesh\n"
+            "def _own_mesh():\n"
+            "    import numpy as np\n"
+            "    return _SneakyMesh(np.array(jax.devices()), ('k',))\n"
+        )
+        assert "SMK112" in rules_hit(broken, path=real)
+
+    def test_real_warmup_suppression_not_stale(self):
+        """compile/warmup.py's AOT-topology branch carries the one
+        justified SMK112 suppression — it must keep matching a real
+        finding (a stale justified suppression is itself SMK100)."""
+        real = "smk_tpu/compile/warmup.py"
+        src = repo_file(real)
+        hits = rules_hit(src, path=real)
+        assert "SMK112" not in hits and "SMK100" not in hits
+
+
 class TestTreeGate:
     def test_repo_lints_clean(self):
         """The acceptance gate as a tier-1 test: zero unsuppressed
@@ -870,7 +981,7 @@ class TestTreeGate:
 
 @pytest.mark.parametrize("rule_id", [
     "SMK101", "SMK102", "SMK103", "SMK104", "SMK105", "SMK106",
-    "SMK107", "SMK108",
+    "SMK107", "SMK108", "SMK109", "SMK110", "SMK111", "SMK112",
 ])
 def test_every_rule_documented_in_catalogue(rule_id):
     from smk_tpu.analysis.lint import _list_rules
