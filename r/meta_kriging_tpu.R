@@ -66,6 +66,7 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
                                 fault.max.retries = 2L,
                                 watchdog = FALSE,
                                 dist.init.timeout.s = 120,
+                                ckpt.commit.timeout.s = 120,
                                 n.report = NULL,
                                 checkpoint.path = NULL,
                                 compile.store.dir = NULL,
@@ -144,6 +145,16 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
   # only its subsets — the dropped domain indices are returned as
   # $domains.dropped and the combined posterior is built over the
   # survivors (see the README's "Fault tolerance" section).
+  # ckpt.commit.timeout.s: the distributed checkpoint's per-commit
+  # deadline (ISSUE 13, SMKConfig ckpt_commit_timeout_s). Under a
+  # multi-host mesh every chunk boundary is published as one
+  # two-phase-committed GENERATION — each host lands its shard
+  # files, a cross-host barrier confirms them, process 0 publishes
+  # the manifest; a dead peer turns the commit into a typed error
+  # within this deadline instead of a hang, and a relaunch resumes
+  # from the last COMMITTED generation (see the README's
+  # "Distributed checkpointing" subsection). Pure coordination:
+  # checkpoints written under one deadline resume under any other.
   # compile.store.dir: directory of the AOT program store (ISSUE 8,
   # smk_tpu/compile/). The first fit at a given shape builds its
   # compiled programs ahead of time and serializes them there; every
@@ -233,6 +244,7 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
     fault_max_retries = as.integer(fault.max.retries),
     watchdog = watchdog,
     dist_init_timeout_s = dist.init.timeout.s,
+    ckpt_commit_timeout_s = ckpt.commit.timeout.s,
     compile_store_dir = compile.store.dir,
     run_log_dir = run.log.dir,
     priors = smk$PriorConfig(a_prior = k.prior)

@@ -30,6 +30,26 @@ Usage: python scripts/_dcn_worker.py <process_id> <num_processes> <port> [mode]
   grids across processes, the reduction runs replicated); prints the
   combined digest plus the topology fingerprint the compile-store
   buckets would key.
+- ``ckpt`` (ISSUE 13, scripts/chaos_probe.py --dist-ckpt): the
+  DISTRIBUTED-CHECKPOINT legs — the chunked executor under the
+  global 2-process mesh with ``checkpoint_path`` set, i.e. format
+  v8: per-host shard files + two-phase-committed generations.
+  Driven by env vars so one argv protocol covers every leg:
+  SMK_DCN_CKPT_PATH (the shared checkpoint path, required),
+  SMK_DCN_CKPT_STOP (stop_after_chunks — the kill-the-run hook),
+  SMK_DCN_CKPT_KILL_GEN (arm the kill_process_at_generation chaos
+  injector on the LEADER: SimulatedKill between shard-land and
+  manifest-publish of that generation; the peer surfaces a typed
+  CkptCommitError within the commit deadline),
+  SMK_DCN_CKPT_STORE (compile store dir),
+  SMK_DCN_CKPT_GUARD_RESUME=1 (two fits: an unguarded partial run
+  that warms store+process, then a recompile_guard(0) resume),
+  SMK_DCN_CKPT_POLICY (fault_policy, default abort),
+  SMK_DCN_CKPT_TIMEOUT (ckpt_commit_timeout_s, default 60),
+  SMK_DCN_CKPT_CHUNK (chunk_iters, default 5). Prints one
+  ``DCN_CKPT <json>`` line with the outcome, per-process local-shard
+  draw digests, the generation telemetry, and the pre-run manifest
+  generation (the resume provenance).
 """
 
 import json
@@ -128,6 +148,174 @@ def main():
             }),
             flush=True,
         )
+        return
+
+    if mode == "ckpt":
+        import contextlib
+        import hashlib
+
+        from smk_tpu.analysis.sanitizers import recompile_guard
+        from smk_tpu.parallel import checkpoint as dck
+        from smk_tpu.parallel.checkpoint import CkptCommitError
+        from smk_tpu.parallel.recovery import fit_subsets_chunked
+        from smk_tpu.testing.faults import (
+            SimulatedKill,
+            kill_process_at_generation,
+        )
+        from smk_tpu.utils.tracing import ChunkPipelineStats
+        import dataclasses
+
+        path = os.environ["SMK_DCN_CKPT_PATH"]
+        stop = os.environ.get("SMK_DCN_CKPT_STOP")
+        kill_gen = os.environ.get("SMK_DCN_CKPT_KILL_GEN")
+        store = os.environ.get("SMK_DCN_CKPT_STORE") or None
+        guard_resume = (
+            os.environ.get("SMK_DCN_CKPT_GUARD_RESUME") == "1"
+        )
+        chunk = int(os.environ.get("SMK_DCN_CKPT_CHUNK", "5"))
+        cfg = dataclasses.replace(
+            cfg,
+            fault_policy=os.environ.get(
+                "SMK_DCN_CKPT_POLICY", "abort"
+            ),
+            ckpt_commit_timeout_s=float(
+                os.environ.get("SMK_DCN_CKPT_TIMEOUT", "60")
+            ),
+            compile_store_dir=store,
+        )
+        model = SpatialGPSampler(cfg)
+
+        def manifest_field(name):
+            if not (
+                os.path.exists(path)
+                and dck.is_distributed_manifest(path)
+            ):
+                return None
+            from smk_tpu.utils.checkpoint import load_pytree
+
+            man = load_pytree(path, dck._manifest_like())
+            return int(np.asarray(man[name])[0])
+
+        def manifest_generation():
+            return manifest_field("generation")
+
+        def local_sha(res, upto=None):
+            h = hashlib.sha256()
+            for tree in (res.param_samples, res.w_samples):
+                local = dck.local_tree_np(tree)
+                for leaf in jax.tree_util.tree_leaves(local):
+                    a = np.asarray(leaf)
+                    if upto is not None:
+                        a = a[..., :upto, :]
+                    h.update(np.ascontiguousarray(a).tobytes())
+            return h.hexdigest()[:16]
+
+        def one_fit(pstats, guard_label=None, stop_after=None,
+                    at_path=None):
+            ctx = (
+                recompile_guard(0, guard_label)
+                if guard_label is not None
+                else contextlib.nullcontext()
+            )
+            with ctx as g:
+                res = fit_subsets_chunked(
+                    model, part, coords_test, x_test,
+                    jax.random.key(2), chunk_iters=chunk, mesh=mesh,
+                    checkpoint_path=at_path or path,
+                    pipeline_stats=pstats,
+                    stop_after_chunks=stop_after,
+                )
+            return res, (g.compiles if guard_label else None)
+
+        filled_at_start = manifest_field("filled")
+        out = {
+            "process_id": topo.process_id,
+            "num_processes": topo.num_processes,
+            "resume_from_generation": manifest_generation(),
+            "filled_at_start": filled_at_start,
+        }
+        import warnings as _warnings
+
+        kill_ctx = (
+            kill_process_at_generation(int(kill_gen))
+            if kill_gen and topo.process_id == 0
+            else contextlib.nullcontext()
+        )
+        pstats = ChunkPipelineStats()
+        try:
+            with _warnings.catch_warnings(record=True) as caught:
+                _warnings.simplefilter("always")
+                with kill_ctx:
+                    if guard_resume:
+                        # fit 1: FULL, unguarded, on a throwaway
+                        # checkpoint path — populates the store with
+                        # every program (a partial run never reaches
+                        # finalize) and warms the process's small
+                        # jit caches
+                        one_fit(
+                            ChunkPipelineStats(),
+                            at_path=path + ".warm",
+                        )
+                        # fit 2: partial at the REAL path — the
+                        # committed-generation prefix the guarded
+                        # resume continues from
+                        one_fit(
+                            ChunkPipelineStats(),
+                            stop_after=int(
+                                os.environ.get(
+                                    "SMK_DCN_CKPT_WARM_STOP", "7"
+                                )
+                            ),
+                        )
+                        res, compiles = one_fit(
+                            pstats,
+                            guard_label="dcn ckpt warm resume",
+                        )
+                        out["compiles_observed"] = compiles
+                    else:
+                        res, _ = one_fit(
+                            pstats,
+                            stop_after=int(stop) if stop else None,
+                        )
+            out["warnings"] = sorted({
+                "elastic" if "elastic resume" in str(w.message)
+                else "orphan" if "orphan shard" in str(w.message)
+                else "other"
+                for w in caught
+            })
+            if res is None:
+                out["outcome"] = "stopped"
+            else:
+                out["outcome"] = "completed"
+                out["local_sha"] = local_sha(res)
+                if filled_at_start:
+                    # digest of exactly the rows that were COMMITTED
+                    # before this (possibly elastic) resume — the
+                    # loaded-from-shards region, bitwise comparable
+                    # against the writing topology's run
+                    out["committed_rows_sha"] = local_sha(
+                        res, upto=filled_at_start
+                    )
+                from smk_tpu.parallel.combine import gather_grids
+
+                combined = np.asarray(
+                    combine_quantile_grids(
+                        gather_grids(res.param_grid, mesh),
+                        cfg.combiner,
+                    )
+                )
+                out["combined_sum"] = float(combined.sum())
+                out["finite"] = bool(np.isfinite(combined).all())
+        except SimulatedKill as e:
+            out["outcome"] = "killed"
+            out["error"] = str(e)[:120]
+        except CkptCommitError as e:
+            out["outcome"] = "commit_abort"
+            out["error"] = str(e)[:160]
+        out["generations"] = pstats.ckpt_generations
+        out["ckpt_commit_s"] = round(pstats.ckpt_commit_s, 4)
+        out["final_generation"] = manifest_generation()
+        print("DCN_CKPT " + json.dumps(out), flush=True)
         return
 
     def fit_and_combine():

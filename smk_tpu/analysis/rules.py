@@ -1443,6 +1443,190 @@ class MeshHygieneRule(Rule):
                 )
 
 
+# ---------------------------------------------------------------------------
+# SMK113 — atomic-write discipline in durable-state modules
+# ---------------------------------------------------------------------------
+
+# The modules whose on-disk output is LATER RE-READ by resume/store
+# code — checkpoint manifests/segments/shards, serialized
+# executables, JSONL protocol/run-log records. A direct truncating
+# write at a live path in any of these can strand a torn file a
+# crash makes permanent; every write must go through write-to-temp +
+# atomic-rename (os.replace) or the append-atomic reporter.
+_DURABLE_MODULES = (
+    "smk_tpu/utils/checkpoint",
+    "smk_tpu/parallel/checkpoint",
+    "smk_tpu/parallel/recovery",
+    "smk_tpu/compile/store",
+    "smk_tpu/compile/xla_cache",
+    "smk_tpu/obs/reporter",
+    "smk_tpu/obs/events",
+)
+
+
+class AtomicWriteRule(Rule):
+    id = "SMK113"
+    name = "atomic-write-discipline"
+    doc = (
+        "durable-state modules (checkpoint, compile store, reporter "
+        "— files later re-read by resume/store code) may not open a "
+        "path for truncating write (open(path, 'w'/'wb'), io.open, "
+        "Path.open, write_text/write_bytes) outside a function that "
+        "completes the write-to-temp + atomic-rename shape "
+        "(os.replace/os.rename in the same function) — a crash "
+        "mid-write otherwise strands a TORN file at a live path, "
+        "exactly the corruption class the v5-v8 checkpoint layouts' "
+        "crash-window guarantees exclude (ISSUE 13). Append mode "
+        "('a') stays legal: it never destroys committed bytes (the "
+        "reporter's flush-per-record contract)."
+    )
+
+    def applies(self, module):
+        norm = module.norm_path()
+        return any(z in norm for z in _DURABLE_MODULES)
+
+    @staticmethod
+    def _open_aliases(tree) -> Set[str]:
+        """Local names that ARE an open function: the builtin (always
+        'open'), ``io.open`` member imports and their aliases — the
+        same from-import coverage SMK110/111 grew."""
+        out = {"open"}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                if node.module in ("io", "builtins") and node.level == 0:
+                    for a in node.names:
+                        if a.name == "open":
+                            out.add(a.asname or a.name)
+        return out
+
+    @staticmethod
+    def _mode_arg(node: ast.Call, pos: int):
+        """The mode argument of an open()-shaped call: (present,
+        constant-value-or-None). ``pos`` is the positional index of
+        the mode (1 for open/io.open, 0 for the ``x.open(mode)``
+        method spelling); mode= keyword wins either way."""
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                if isinstance(kw.value, ast.Constant):
+                    return True, kw.value.value
+                return True, None
+        if len(node.args) > pos:
+            arg = node.args[pos]
+            if isinstance(arg, ast.Constant):
+                return True, arg.value
+            return True, None
+        return False, "r"
+
+    @staticmethod
+    def _blessed(fn) -> bool:
+        """The enclosing function completes the atomic-rename shape:
+        it also calls os.replace/os.rename, so the opened path is
+        (by the repo convention) a temp the rename publishes."""
+        if fn is None:
+            return False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                chain = attr_chain(node.func)
+                if chain[-1:] in (("replace",), ("rename",)) and (
+                    len(chain) >= 2 and chain[0] == "os"
+                ):
+                    return True
+        return False
+
+    def check(self, module, ctx):
+        opens = self._open_aliases(module.tree)
+        idx = _FuncIndex()
+        idx.visit(module.tree)
+
+        def enclosing(node):
+            for fn in idx.funcs:
+                if isinstance(
+                    fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    for sub in ast.walk(fn):
+                        if sub is node:
+                            return fn
+            return None
+
+        # one pass over every open()-shaped call / pathlib write
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            mode_pos = None
+            if (len(chain) == 1 and chain[0] in opens) or chain[
+                -2:
+            ] == ("io", "open"):
+                # builtin/io/from-import-aliased open(file, mode)
+                mode_pos = 1
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "open"
+                and chain[-2:] != ("io", "open")
+            ):
+                # the method spelling: Path(...).open(mode) — mode
+                # leads (gzip.open-style module calls with a path
+                # first still land here; their mode arg 0 is the
+                # path, a non-constant → the non-constant-mode arm
+                # asks for restructuring or suppression, which is
+                # the safe default in a durable module)
+                mode_pos = 0
+            is_open = mode_pos is not None
+            is_pathwrite = isinstance(node.func, ast.Attribute) and (
+                node.func.attr in ("write_text", "write_bytes")
+            )
+            if is_pathwrite:
+                if not self._blessed(enclosing(node)):
+                    yield self.finding(
+                        module, node,
+                        f".{node.func.attr}(...) writes a live path "
+                        "in a durable-state module with no atomic "
+                        "rename in the enclosing function — a crash "
+                        "mid-write strands a torn file resume/store "
+                        "code will later re-read; write to a temp "
+                        "and os.replace it",
+                    )
+                continue
+            if not is_open:
+                continue
+            present, mode = self._mode_arg(node, mode_pos)
+            if not present:
+                continue  # default mode "r"
+            if mode is None:
+                # non-constant mode: cannot prove it is not a
+                # truncating write — the reporter's "a"-or-"w"
+                # conditional is the one justified case (suppressed
+                # with its append-atomic contract)
+                if not self._blessed(enclosing(node)):
+                    yield self.finding(
+                        module, node,
+                        "open(...) with a non-constant mode in a "
+                        "durable-state module — smklint cannot "
+                        "verify the write is not truncating a live "
+                        "path; make the mode a literal, restructure "
+                        "to temp + os.replace, or suppress with the "
+                        "justification",
+                    )
+                continue
+            if not isinstance(mode, str) or "w" not in mode:
+                continue  # read/append modes never truncate history
+            if self._blessed(enclosing(node)):
+                continue
+            yield self.finding(
+                module, node,
+                f"open(..., {mode!r}) truncates a path in a "
+                "durable-state module with no os.replace/os.rename "
+                "in the enclosing function — a crash mid-write "
+                "strands a TORN file at a live path that "
+                "resume/store code later re-reads (the v5-v8 "
+                "checkpoint crash-window guarantees assume "
+                "write-to-temp + atomic rename); use the blessed "
+                "helpers (utils/checkpoint._atomic_savez, "
+                "compile/store.save, obs/reporter) or rename from a "
+                "temp",
+            )
+
+
 ALL_RULES = [
     BatchingRuleRule(),
     HostNondeterminismRule(),
@@ -1456,4 +1640,5 @@ ALL_RULES = [
     TelemetryDisciplineRule(),
     UnboundedWaitRule(),
     MeshHygieneRule(),
+    AtomicWriteRule(),
 ]

@@ -210,7 +210,16 @@ def fit_meta_kriging(
       format v6 writes an O(1)-sized manifest plus one O(chunk)
       checksummed draw
       segment per sampling chunk, all atomic-renamed; an interrupted
-      call resumes bit-exactly.
+      call resumes bit-exactly. Under a MULTI-PROCESS mesh the
+      checkpoint is the distributed format v8 (ISSUE 13,
+      parallel/checkpoint.py): every process writes only its
+      addressable shards to per-host segment files and each boundary
+      is published as one two-phase-committed GENERATION
+      (``config.ckpt_commit_timeout_s`` bounds the commit barriers),
+      so a crashed host rolls back to the last committed generation
+      and a relaunch — same topology, or elastically onto fewer
+      hosts — resumes from it; ``checkpoint_path`` must then live on
+      a filesystem every host shares.
     - ``progress``: per-chunk callback(dict) with iteration count and
       running phi acceptance (reference n.report parity, R:84). A
       callback that raises is caught with a one-time warning and the

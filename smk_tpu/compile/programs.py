@@ -91,6 +91,10 @@ _DIGEST_NEUTRAL = dict(
     watchdog_margin=10.0,
     dist_init_timeout_s=120.0,
     dist_init_retries=3,
+    # distributed-checkpoint commit deadline (ISSUE 13): pure
+    # coordination — a store built under one deadline must serve
+    # runs under any other
+    ckpt_commit_timeout_s=120.0,
 )
 
 

@@ -390,6 +390,21 @@ class SMKConfig:
     dist_init_timeout_s: float = 120.0
     dist_init_retries: int = 3
 
+    # Distributed checkpointing (ISSUE 13, parallel/checkpoint.py):
+    # under a multi-process mesh every process writes only its
+    # ADDRESSABLE shards of the carried state and draw accumulators
+    # to per-host segment files, and each chunk boundary is published
+    # as one GENERATION by a coordinated two-phase commit — all
+    # processes land their shard files, a cross-host barrier confirms
+    # it, then process 0 publishes the one generation manifest. This
+    # knob bounds each commit barrier (and the shard-digest agreement
+    # of the cross-host run-identity check): a dead peer turns the
+    # commit into a typed CkptCommitError within this deadline
+    # instead of an indefinite hang (the SMK111 discipline). Pure
+    # coordination: normalized out of the run-identity hash and the
+    # compile digest (it cannot change the chain).
+    ckpt_commit_timeout_s: float = 120.0
+
     # Chunk watchdog (ISSUE 11, parallel/domains.ChunkWatchdog):
     # when True, the chunked executor runs each chunk's dispatch and
     # boundary work under a deadline of
@@ -647,6 +662,8 @@ class SMKConfig:
             raise ValueError("dist_init_timeout_s must be > 0")
         if self.dist_init_retries < 0:
             raise ValueError("dist_init_retries must be >= 0")
+        if self.ckpt_commit_timeout_s <= 0:
+            raise ValueError("ckpt_commit_timeout_s must be > 0")
         if not isinstance(self.watchdog, bool):
             raise ValueError(
                 f"watchdog must be a bool, got {self.watchdog!r}"

@@ -57,6 +57,7 @@ class JsonlWriter:
         self.path = path
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
+        # smklint: disable=SMK113 -- the reporter IS the blessed append-atomic writer: flush-per-record + read_jsonl's torn-trailing-line tolerance are its atomicity model (truncate-then-append for probes, pure append for run logs); a temp+rename would break mid-run tailing
         self._f = open(path, "a" if append else "w", encoding="utf-8")
         self._lock = threading.Lock()
         self._closed = False

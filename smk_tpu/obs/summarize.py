@@ -154,6 +154,7 @@ def summarize(path: str) -> Dict[str, Any]:
     programs = [e["attrs"] for e in _events_named(run, "program")]
     live = [e["attrs"] for e in _events_named(run, "live_diagnostics")]
     ckpt = [e["attrs"] for e in _events_named(run, "ckpt_write")]
+    commits = [e["attrs"] for e in _events_named(run, "ckpt_commit")]
     breakdown = chunk_breakdown(run)
     span_walls: Dict[str, float] = {}
     for s in run["spans"]:
@@ -193,6 +194,26 @@ def summarize(path: str) -> Dict[str, Any]:
                 sum(float(c.get("seconds", 0.0)) for c in ckpt), 4
             ),
             "bytes": sum(int(c.get("nbytes", 0)) for c in ckpt),
+        },
+        # ISSUE 13: the distributed checkpoint's coordinated-commit
+        # timeline — one ckpt_commit EVENT per published generation
+        # (generation/it/filled/n_processes + the barrier+publish
+        # seconds), plus the sync-pipeline "ckpt_commit" span wall
+        # when present (the overlap pipeline commits on the writer
+        # thread and emits events only — spans are a caller-side
+        # stack). Empty/None on single-host v7 runs.
+        "ckpt_commit": {
+            "n_generations": len(commits),
+            "seconds": round(
+                sum(float(c.get("seconds", 0.0)) for c in commits), 4
+            ),
+            "span_s": _span_wall("ckpt_commit"),
+            "last_generation": (
+                commits[-1].get("generation") if commits else None
+            ),
+            "n_processes": (
+                commits[-1].get("n_processes") if commits else None
+            ),
         },
         # ISSUE 12: the posterior-combination tail of the pipeline —
         # the on-device all-gather (its own "gather" span under a
@@ -299,6 +320,14 @@ def main(argv: List[str]) -> int:
                 f"  resample_predict: {cb['resample_predict_s']}s"
                 if cb["resample_predict_s"] is not None else ""
             )
+        )
+    cc = summary["ckpt_commit"]
+    if cc["n_generations"]:
+        print(
+            f"\nckpt commits: {cc['n_generations']} generation(s), "
+            f"{cc['seconds']}s coordination "
+            f"(last generation {cc['last_generation']}, "
+            f"{cc['n_processes']} process(es))"
         )
     if summary["watchdog"]["fired"]:
         print(

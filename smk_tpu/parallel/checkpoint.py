@@ -1,0 +1,1472 @@
+"""Distributed checkpointing — format v8 (ISSUE 13).
+
+The single-host checkpoint (utils/checkpoint.py primitives +
+parallel/recovery._SegmentedCheckpoint, formats v5-v7) serializes the
+FULL carried state and draw accumulators from one process. Under a
+multi-process mesh that is impossible by construction: each host can
+address only its own shards of the globally-sharded arrays, and PR 11
+left multi-process checkpointing as a typed NotImplementedError. This
+module deletes that limitation with a genuinely distributed layout:
+
+- **Per-host shard files.** Every process persists only its
+  ADDRESSABLE rows of the carried state
+  (``<path>.pPPP.gGGGGG.state.npz``, one per committed generation)
+  and appends its rows of each sampling chunk's new draws as ordered
+  per-process segments (``<path>.pPPP.segNNNNN.npz`` — the v5 segment
+  layout and checksums verbatim, via utils/checkpoint.save_segment,
+  just rooted at a per-process prefix). One
+  :class:`~smk_tpu.utils.checkpoint.BackgroundWriter` per process
+  keeps the overlap pipeline's writes off the dispatch path.
+
+- **Coordinated two-phase commit.** A chunk boundary becomes one
+  GENERATION: (1) every process lands its shard files, (2) a bounded
+  cross-host barrier (parallel/distributed.barrier_sync,
+  ``SMKConfig.ckpt_commit_timeout_s``) confirms every shard for the
+  boundary exists, (3) process 0 alone publishes the ONE generation
+  manifest (atomic rename at ``path``), (4) a second barrier releases
+  the peers. A crash in ANY window leaves the previously published
+  generation fully intact: shard files of the torn generation are
+  plain orphans at deterministic names, detected and overwritten on
+  resume — the v5/v7 single-host crash-window guarantees, promoted to
+  the multi-host case.
+
+- **Elastic resume along two axes.** Same topology: each process
+  loads its OWN shard files and device_puts them straight back under
+  the canonical leading-K NamedShardings
+  (``jax.make_array_from_process_local_data``) — no gather, no
+  reshard, survivor draws bit-identical. Smaller or re-laid-out
+  topology: every process re-gathers ALL shard files from the shared
+  filesystem, reassembles the full arrays, and the executor re-shards
+  them through the PR 10 elastic path (domain ladders re-derived,
+  topology change warned). So a dead host becomes: watchdog fires
+  ``ChunkTimeoutError`` naming the domain → the run aborts (or
+  degrades) → a relaunch on the surviving hosts resumes from the last
+  COMMITTED generation.
+
+- **Cross-host run identity.** v7's ``_run_identity`` samples every
+  data leaf to host — impossible on non-addressable shards, so
+  multi-process runs used to skip the wrong-config tripwire entirely.
+  :func:`distributed_run_identity` computes a per-process digest of
+  the addressable shards (exact plain + GLOBAL-position-weighted
+  mod-2^32 sums of the raw bit patterns — additive across shards, so
+  the fold is TOPOLOGY-INDEPENDENT), all-gathers the per-process
+  contributions through the coordination service, and folds them
+  identically on every process; an elastic resume on one host
+  recomputes the same digest from the unsharded arrays.
+
+Operational requirement: all shard files and the manifest live under
+one ``checkpoint_path`` prefix that every process can read and write
+— a shared filesystem (GCS fuse, NFS) on a real pod, a local tmpdir
+in the 2-process CPU harness. That is the standard contract of every
+distributed checkpointing system.
+
+Single-host checkpoints are UNTOUCHED: a run without a multi-process
+mesh keeps writing format v7 byte-identically, and v7 files keep
+loading (the executor picks this layer only under a multi-process
+mesh or when ``checkpoint_path`` already holds a v8 manifest — the
+elastic-resume-onto-one-host case).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import warnings
+import zlib
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from smk_tpu.analysis.sanitizers import explicit_d2h
+from smk_tpu.parallel.distributed import (
+    CollectiveTimeoutError,
+    allgather_bytes,
+    barrier_sync,
+)
+from smk_tpu.parallel.domains import FailureDomainMap
+from smk_tpu.utils.checkpoint import (
+    BackgroundWriter,
+    is_key_leaf,
+    load_pytree,
+    load_segment,
+    save_pytree,
+    save_segment,
+    segment_path,
+)
+from smk_tpu.utils.tracing import monotonic
+
+# Distributed checkpoint format version. v8 = the sharded generation
+# layout this module owns; the single-host manifest formats v5-v7
+# stay in parallel/recovery.py (CKPT_VERSION) and are byte-unchanged.
+DIST_CKPT_VERSION = 8
+
+# Testing hook (tests/test_dist_checkpoint.py): route a SINGLE-process
+# run through the v8 layer — the trivial one-shard layout with no-op
+# barriers — so the generation/commit/rollback machinery is
+# executor-exercisable in-gate without a real multi-process job.
+# Never set in library code.
+FORCE_DISTRIBUTED_FOR_TESTING = False
+
+
+class CkptCommitError(RuntimeError):
+    """A generation commit could not complete: a peer failed to land
+    its shards (or to acknowledge the publish) within
+    ``ckpt_commit_timeout_s``. The previously PUBLISHED generation is
+    intact by construction — resume from it."""
+
+
+# ---------------------------------------------------------------------------
+# shard layout
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardLayout:
+    """Which contiguous subset rows each process persists.
+
+    ``row_ranges[p]`` is process ``p``'s ``(start, stop)`` ownership
+    (processes ordered by ascending jax ``process_index``);
+    ``process_id`` is THIS process's position in that order. Derived
+    from the executor's one layout oracle
+    (:func:`~smk_tpu.parallel.executor.all_process_row_ranges`) so
+    what a host persists can never drift from what it executes."""
+
+    process_id: int
+    row_ranges: tuple  # ((start, stop), ...) per process position
+    k: int
+
+    @property
+    def n_processes(self) -> int:
+        return len(self.row_ranges)
+
+    @property
+    def rows(self) -> Tuple[int, int]:
+        return self.row_ranges[self.process_id]
+
+    @property
+    def is_leader(self) -> bool:
+        return self.process_id == 0
+
+    @classmethod
+    def current(cls, k: int, mesh=None) -> "ShardLayout":
+        """The layout of the CURRENT topology: one shard per process
+        of the mesh, or the trivial whole-K single shard when there
+        is no multi-process mesh (single-host runs, forced-v8 tests,
+        and the elastic resume of a multi-host checkpoint onto one
+        surviving host)."""
+        if mesh is not None:
+            from smk_tpu.parallel.executor import (
+                all_process_row_ranges,
+                subset_device_assignment,
+            )
+
+            devices = subset_device_assignment(k, mesh)
+            procs = sorted(
+                {int(getattr(d, "process_index", 0)) for d in devices}
+            )
+            if len(procs) > 1:
+                me = int(jax.process_index())
+                return cls(
+                    process_id=procs.index(me),
+                    row_ranges=tuple(all_process_row_ranges(k, mesh)),
+                    k=int(k),
+                )
+        return cls(process_id=0, row_ranges=((0, int(k)),), k=int(k))
+
+
+def shard_state_path(path: str, process_id: int, generation: int) -> str:
+    """On-disk name of one process's carried-state shard for one
+    generation. Generation-scoped and deterministic: a torn commit's
+    orphans sit at exactly the names the resumed run's next commit
+    atomically overwrites."""
+    return f"{path}.p{process_id:03d}.g{generation:05d}.state.npz"
+
+
+def shard_segment_prefix(path: str, process_id: int) -> str:
+    """Per-process root the v5 segment naming hangs off:
+    ``<path>.pPPP.segNNNNN.npz`` via utils/checkpoint.segment_path."""
+    return f"{path}.p{process_id:03d}"
+
+
+# ---------------------------------------------------------------------------
+# addressable-shard host access
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _shard_clone(leaf):
+    """Fresh device buffer(s) holding ``leaf`` — sharding-preserving,
+    so the clone's addressable shards are exactly this process's rows
+    (the donation-safety step LocalShardSnapshot shares with
+    executor.HostSnapshot)."""
+    return jnp.copy(leaf)
+
+
+def _dedup_shards(leaf) -> list:
+    """This process's addressable shards of ``leaf``, one per distinct
+    global index (replicated copies collapse to one), ordered by
+    leading-axis start so concatenation reproduces the contiguous
+    local row block."""
+    def start_of(s):
+        if s.index and isinstance(s.index[0], slice):
+            return s.index[0].start or 0
+        return 0
+
+    seen = set()
+    out = []
+    for s in sorted(leaf.addressable_shards, key=start_of):
+        ix = tuple(
+            (sl.start, sl.stop, sl.step)
+            if isinstance(sl, slice) else ("i", sl)
+            for sl in s.index
+        )
+        if ix in seen:
+            continue
+        seen.add(ix)
+        out.append(s)
+    return out
+
+
+def _local_rows_np(leaf) -> np.ndarray:
+    """The process-local contiguous row block of one (possibly
+    globally sharded) array, as numpy. Host/numpy leaves pass
+    through whole (the single-shard degenerate layout)."""
+    if not isinstance(leaf, jax.Array):
+        return np.asarray(leaf)
+    shards = _dedup_shards(leaf)
+    datas = [np.asarray(s.data) for s in shards]
+    if len(datas) == 1:
+        return datas[0]
+    return np.concatenate(datas, axis=0)
+
+
+def local_tree_nbytes(tree) -> int:
+    """Bytes of THIS process's addressable (deduplicated) shards
+    across a pytree — the per-host D2H accounting the distributed
+    snapshot reports (the v8 analog of executor.tree_nbytes)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array):
+            try:
+                total += sum(
+                    int(s.data.size) * leaf.dtype.itemsize
+                    for s in _dedup_shards(leaf)
+                )
+                continue
+            except Exception:  # pragma: no cover - backend quirk
+                pass
+        if hasattr(leaf, "dtype"):
+            total += int(np.size(leaf)) * getattr(
+                leaf.dtype, "itemsize", 4
+            )
+    return total
+
+
+def local_tree_np(tree, *, tag: str = "host_snapshot"):
+    """Materialize the process-local rows of every leaf (typed PRNG
+    keys lowered to raw key data, matching HostSnapshot's
+    convention). One sanctioned, ledger-tagged D2H."""
+    def one(leaf):
+        if is_key_leaf(leaf):
+            leaf = jax.random.key_data(leaf)
+        return _local_rows_np(leaf)
+
+    with explicit_d2h(tag, nbytes=local_tree_nbytes(tree)):
+        return jax.tree_util.tree_map(one, tree)
+
+
+class LocalShardSnapshot:
+    """Async device→host snapshot of THIS process's addressable
+    shards of a pytree about to be donated — executor.HostSnapshot's
+    contract (clone on device, then non-blocking per-shard host
+    copies), restricted to the rows this host persists. ``get()``
+    materializes the local numpy row block per leaf."""
+
+    def __init__(self, tree):
+        def prep(leaf):
+            if is_key_leaf(leaf):
+                leaf = jax.random.key_data(leaf)
+            if isinstance(leaf, jax.Array):
+                clone = _shard_clone(leaf)
+                for s in _dedup_shards(clone):
+                    try:
+                        s.data.copy_to_host_async()
+                    except Exception:  # pragma: no cover - quirk
+                        pass
+                return clone
+            return leaf
+
+        self._tree = jax.tree_util.tree_map(prep, tree)
+        self.nbytes = local_tree_nbytes(self._tree)
+
+    def get(self):
+        with explicit_d2h("host_snapshot", nbytes=self.nbytes):
+            return jax.tree_util.tree_map(
+                _local_rows_np, self._tree
+            )
+
+
+def fetch_global(
+    arr, *, timeout_s: float = 120.0, tag: str = "chunk_stats"
+) -> np.ndarray:
+    """Materialize a (possibly globally-sharded) array to host numpy
+    on EVERY process. Fully-addressable and fully-replicated arrays
+    take the plain ``np.asarray`` fast path — byte-identical to the
+    historical single-host fetches. A leading-axis-sharded
+    multi-process array (the quarantine guard's (K,) finite vector
+    under a multi-process mesh) is assembled from each process's
+    addressable rows through one BOUNDED all-gather — every process
+    must call in the same order (the executor's boundary loop is
+    SPMD), and a dead peer surfaces as a typed
+    CollectiveTimeoutError instead of the historical
+    non-addressable-fetch crash."""
+    if not isinstance(arr, jax.Array):
+        return np.asarray(arr)
+    if arr.is_fully_addressable or arr.sharding.is_fully_replicated:
+        return np.asarray(arr)
+    out = np.zeros(arr.shape, arr.dtype)
+    row_size = (
+        int(np.prod(arr.shape[1:], dtype=np.int64))
+        if arr.ndim > 1 else 1
+    )
+    pieces = []
+    for s in _dedup_shards(arr):
+        start = (
+            s.index[0].start or 0
+            if s.index and isinstance(s.index[0], slice) else 0
+        )
+        data = np.ascontiguousarray(np.asarray(s.data))
+        pieces.append((start, data))
+    header = np.asarray(
+        [[a, a + d.shape[0]] for a, d in pieces], np.int64
+    )
+    payload = (
+        np.asarray([len(pieces)], np.int64).tobytes()
+        + header.astype("<i8").tobytes()
+        + b"".join(d.astype(d.dtype).tobytes() for _, d in pieces)
+    )
+    for buf in allgather_bytes(tag, payload, timeout_s=timeout_s):
+        n = int(np.frombuffer(buf[:8], "<i8")[0])
+        hdr = np.frombuffer(
+            buf[8: 8 + 16 * n], "<i8"
+        ).reshape(n, 2)
+        ofs = 8 + 16 * n
+        for a, b in hdr:
+            nrows = int(b - a)
+            nbytes = nrows * row_size * arr.dtype.itemsize
+            out[int(a): int(b)] = np.frombuffer(
+                buf[ofs: ofs + nbytes], arr.dtype
+            ).reshape((nrows,) + tuple(arr.shape[1:]))
+            ofs += nbytes
+    return out
+
+
+# ---------------------------------------------------------------------------
+# cross-host run identity (ISSUE 13 satellite: the wrong-config
+# tripwire multi-process runs used to skip)
+# ---------------------------------------------------------------------------
+
+
+def identity_config_repr(cfg) -> bytes:
+    """The run-identity view of a config: every chain-determining
+    field, with the pipeline/fault/store/obs/host-resilience/commit
+    knobs normalized to fixed values (they cannot change the chain,
+    so resuming across them must stay legal — the same set
+    parallel/recovery._run_identity and the compile digest use)."""
+    cfg_ident = dataclasses.replace(
+        cfg,
+        chunk_pipeline="sync",
+        fault_policy="abort",
+        fault_max_retries=2,
+        min_surviving_frac=0.5,
+        compile_store_dir=None,
+        xla_cache_dir=None,
+        run_log_dir=None,
+        live_diagnostics=False,
+        profile_dir=None,
+        profile_chunks=None,
+        watchdog=False,
+        watchdog_min_deadline_s=60.0,
+        watchdog_margin=10.0,
+        dist_init_timeout_s=120.0,
+        dist_init_retries=3,
+        # the commit deadline is pure coordination (ISSUE 13): a
+        # checkpoint written under one deadline must resume under
+        # another
+        ckpt_commit_timeout_s=120.0,
+    )
+    return repr(cfg_ident).encode()
+
+
+def _key_bytes(key) -> bytes:
+    if is_key_leaf(key):
+        return np.asarray(jax.random.key_data(key)).tobytes()
+    return np.ascontiguousarray(key).tobytes()
+
+
+def _bits_u32(arr):
+    """Flattened uint32 bit-pattern view of one (device or host)
+    array — every element participates, sub-fp32 perturbations
+    included (the same widening rules as recovery._leaf_fingerprint).
+    Works elementwise, so it applies to a shard exactly as to the
+    whole leaf."""
+    a = jnp.asarray(arr).reshape(-1)
+    itemsize = a.dtype.itemsize
+    if itemsize == 4:
+        return jax.lax.bitcast_convert_type(a, jnp.uint32)
+    if itemsize == 8:
+        return jax.lax.bitcast_convert_type(a, jnp.uint32).reshape(-1)
+    if itemsize == 2:
+        return jax.lax.bitcast_convert_type(a, jnp.uint16).astype(
+            jnp.uint32
+        )
+    return a.astype(jnp.uint32)
+
+
+@jax.jit
+def _offset_sums(flat_u32: jnp.ndarray, offset: jnp.ndarray):
+    """(2,) uint32: the plain wraparound sum of a shard's bit
+    patterns plus the GLOBAL-position-weighted sum (weight = global
+    flat index + 1, supplied through ``offset``). Both are additive
+    mod 2^32 across disjoint flat-contiguous shards — the property
+    that makes the folded digest identical on every topology."""
+    w = (
+        jax.lax.iota(jnp.uint32, flat_u32.shape[0])
+        + offset.astype(jnp.uint32)
+        + jnp.uint32(1)
+    )
+    return jnp.stack([
+        jnp.sum(flat_u32, dtype=jnp.uint32),
+        jnp.sum(flat_u32 * w, dtype=jnp.uint32),
+    ])
+
+
+def leaf_identity_sums(leaf, flat_offset: int = 0) -> np.ndarray:
+    """(2,) uint32 contribution of one array (or one flat-contiguous
+    piece of one, starting at ``flat_offset`` global flat elements
+    in) to the leaf's global identity sums."""
+    arr = leaf
+    if is_key_leaf(arr):
+        arr = jax.random.key_data(arr)
+    bits = _bits_u32(arr)
+    if int(bits.shape[0]) == 0:
+        return np.zeros(2, np.uint32)
+    # 8-byte dtypes expand to two u32 words per element: the flat
+    # offset is in ELEMENTS of the original array, so scale it
+    words_per_elem = max(1, getattr(arr, "dtype", np.dtype("f4")).itemsize // 4)
+    off = jnp.asarray(
+        np.uint32((flat_offset * words_per_elem) % (2 ** 32))
+    )
+    with explicit_d2h("run_identity", nbytes=8):
+        return np.asarray(_offset_sums(bits, off), np.uint32)
+
+
+def _leaf_local_sums(leaf) -> Optional[np.ndarray]:
+    """This process's contribution to one data leaf's identity sums,
+    or None when the leaf is replicated/host-resident and this is not
+    process 0 (replicated content must be counted exactly once per
+    job, or the fold would depend on the process count)."""
+    arr = leaf
+    if is_key_leaf(arr):
+        arr = jax.random.key_data(arr)
+    if isinstance(arr, jax.Array):
+        sharding = getattr(arr, "sharding", None)
+        replicated = (
+            sharding is None or sharding.is_fully_replicated
+        )
+        if not replicated:
+            row_size = int(np.prod(arr.shape[1:], dtype=np.int64)) if arr.ndim else 1
+            total = np.zeros(2, np.uint64)
+            for s in _dedup_shards(arr):
+                for d, sl in enumerate(s.index):
+                    if d == 0:
+                        continue
+                    full = (
+                        isinstance(sl, slice)
+                        and (sl.start or 0) == 0
+                        and (sl.stop is None or sl.stop == arr.shape[d])
+                    )
+                    if not full:
+                        raise ValueError(
+                            "distributed run identity supports "
+                            "leading-axis sharding only; leaf "
+                            f"sharded as {s.index}"
+                        )
+                start = (
+                    s.index[0].start or 0
+                    if s.index and isinstance(s.index[0], slice)
+                    else 0
+                )
+                total += _shard_pair(s.data, start * row_size)
+            return (total % (2 ** 32)).astype(np.uint32)
+    if int(jax.process_index()) != 0:
+        return None
+    return leaf_identity_sums(arr)
+
+
+def _shard_pair(data, flat_offset: int) -> np.ndarray:
+    return leaf_identity_sums(data, flat_offset).astype(np.uint64)
+
+
+def distributed_run_identity(
+    cfg, key, data, beta_init, *, timeout_s: float = 120.0
+) -> np.ndarray:
+    """The v8 run-identity fingerprint: same role and vector layout
+    as recovery._run_identity — [config crc, key crc, one crc per
+    data leaf, (beta crc)] — but each leaf's crc folds the GLOBAL
+    exact plain/position-weighted mod-2^32 sums of its bit patterns,
+    computed shard-locally on every process and agreed through one
+    bounded all-gather. Topology-independent by construction: the
+    same data under 1, 2 or 256 processes yields the same vector, so
+    an elastic resume keeps the wrong-config tripwire single-host
+    runs always had."""
+    crcs = [zlib.crc32(identity_config_repr(cfg))]
+    crcs.append(zlib.crc32(_key_bytes(key)))
+    leaves = list(jax.tree_util.tree_leaves(data))
+    if beta_init is not None:
+        leaves.append(beta_init)
+    shape_crcs = []
+    locals_ = []
+    for leaf in leaves:
+        arr = jax.random.key_data(leaf) if is_key_leaf(leaf) else leaf
+        dt = (
+            arr.dtype if hasattr(arr, "dtype")
+            else np.asarray(arr).dtype
+        )
+        shape_crcs.append(
+            zlib.crc32(
+                repr((tuple(jnp.shape(arr)), str(dt))).encode()
+            )
+        )
+        pair = _leaf_local_sums(leaf)
+        locals_.append(
+            np.zeros(2, np.uint32) if pair is None else pair
+        )
+    payload = np.concatenate(locals_).astype("<u4").tobytes()
+    gathered = allgather_bytes(
+        "run-identity", payload, timeout_s=timeout_s
+    )
+    total = np.zeros(2 * len(leaves), np.uint64)
+    for buf in gathered:
+        total += np.frombuffer(buf, dtype="<u4").astype(np.uint64)
+    total = (total % (2 ** 32)).astype("<u4")
+    for i, h in enumerate(shape_crcs):
+        crcs.append(
+            zlib.crc32(total[2 * i: 2 * i + 2].tobytes(), h)
+        )
+    return np.asarray(crcs, np.uint32)
+
+
+# ---------------------------------------------------------------------------
+# the v8 state machine
+# ---------------------------------------------------------------------------
+
+
+def _manifest_like(k: int = 1, n_proc: int = 1, n_dom: int = 1):
+    """Structure template of the v8 generation manifest (leaf SHAPES
+    come from the file on load; only the dict treedef must match, so
+    the dummy sizes here are irrelevant)."""
+    return {
+        "version": np.zeros(1, np.int64),
+        "generation": np.zeros(1, np.int64),
+        "it": np.zeros(1, np.int64),
+        "meta": np.zeros(6, np.int64),
+        "ident": np.zeros(1, np.uint32),
+        "seg_base": np.zeros(1, np.int64),
+        "n_segments": np.zeros(1, np.int64),
+        "filled": np.zeros(1, np.int64),
+        "n_processes": np.zeros(1, np.int64),
+        "shard_rows": np.zeros((n_proc, 2), np.int64),
+        "fault_attempts": np.zeros(k, np.int64),
+        "fault_dead": np.zeros(k, np.int64),
+        "fault_domain": np.zeros(k, np.int64),
+        "fault_domain_attempts": np.zeros(n_dom, np.int64),
+        "fault_domain_dead": np.zeros(n_dom, np.int64),
+    }
+
+
+def is_distributed_manifest(path: str) -> bool:
+    """Does ``path`` hold a v8 generation manifest (as opposed to a
+    v5-v7 single-host manifest, whose treedef differs)? The executor
+    consults this on resume so an elastic relaunch of a multi-host
+    checkpoint onto fewer hosts routes through the v8 loader."""
+    try:
+        m = load_pytree(path, _manifest_like())
+    except Exception:
+        return False
+    try:
+        return int(np.asarray(m["version"])[0]) == DIST_CKPT_VERSION
+    except Exception:  # pragma: no cover - malformed file
+        return False
+
+
+def checkpoint_supported(mesh=None) -> dict:
+    """Whether mid-flight checkpoint/resume is available for a
+    topology — the honest measurement bench's ``mesh_e2e`` rung
+    records where a typed NotImplementedError skip used to live.
+    Always available since format v8; multi-process topologies
+    additionally require ``checkpoint_path`` on a filesystem every
+    host shares (the universal distributed-checkpoint contract)."""
+    multi = mesh is not None and len(
+        {int(getattr(d, "process_index", 0)) for d in mesh.devices.flat}
+    ) > 1
+    return {
+        "available": True,
+        "format": DIST_CKPT_VERSION if multi else 7,
+        "multi_process": bool(multi),
+        "requires_shared_filesystem": bool(multi),
+    }
+
+
+class DistributedCheckpoint:
+    """v8 checkpoint state machine — one instance per process.
+
+    Mirrors recovery._SegmentedCheckpoint's executor-facing surface
+    (``snapshot``/``save``/``ensure_synced``/``load``/full rewrites)
+    but persists only this process's shard of every array and makes
+    each boundary a two-phase-committed GENERATION (module
+    docstring). Writes run inline (sync pipeline) or on this
+    process's :class:`BackgroundWriter` (overlap) — the commit
+    barriers then overlap the next chunk's device compute.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        meta: np.ndarray,
+        ident: np.ndarray,
+        layout: ShardLayout,
+        *,
+        writer: Optional[BackgroundWriter] = None,
+        pstats=None,
+        local_draws: Optional[Callable] = None,
+        fault_src: Optional[Callable] = None,
+        commit_timeout_s: float = 120.0,
+        run_log=None,
+        barrier=barrier_sync,
+    ):
+        self.path = path
+        self.meta = meta
+        self.ident = ident
+        self.layout = layout
+        self.writer = writer
+        self.pstats = pstats
+        self._local_draws = local_draws
+        self.commit_timeout_s = float(commit_timeout_s)
+        self.run_log = run_log
+        self._barrier = barrier
+        k = int(meta[2])
+        self._fault_src = fault_src or (
+            lambda: (
+                np.zeros(k, np.int64), np.zeros(k, np.int64),
+                np.zeros(k, np.int64), np.zeros(1, np.int64),
+                np.zeros(1, np.int64),
+            )
+        )
+        self.generation = 0
+        self.seg_base = 0
+        self.n_segments = 0
+        self.filled = 0
+        self.degraded = False
+        self._need_full = False
+        # elastic-with-holes resume only: per-boundary appends are
+        # SUSPENDED until the refill publication re-establishes a
+        # chain the CURRENT layout owns (see load()) — an append
+        # would otherwise publish a manifest whose scalar segment
+        # counters still describe the old topology's per-host chains
+        self._suspend_appends = False
+        self._warned_suspended = False
+
+    # -- layout shorthands ----------------------------------------
+
+    @property
+    def pid(self) -> int:
+        return self.layout.process_id
+
+    @property
+    def _seg_prefix(self) -> str:
+        return shard_segment_prefix(self.path, self.pid)
+
+    # -- executor-facing snapshot policy ---------------------------
+
+    def snapshot(self, tree):
+        """(source, d2h_bytes) for one boundary's to-be-donated tree:
+        an async :class:`LocalShardSnapshot` under the overlap
+        pipeline, the live tree (materialized at save time, before
+        the next dispatch) under sync."""
+        if self.writer is not None:
+            snap = LocalShardSnapshot(tree)
+            return snap, snap.nbytes
+        return tree, local_tree_nbytes(tree)
+
+    @staticmethod
+    def _materialize(src):
+        if isinstance(src, LocalShardSnapshot):
+            # smklint: disable=SMK111 -- LocalShardSnapshot.get blocks on already-dispatched async shard copies (same contract as HostSnapshot.get); the chunk watchdog bounds this boundary when armed
+            return src.get()
+        return local_tree_np(src)
+
+    # -- raw write paths (run on the writing thread) ---------------
+
+    def _publish_manifest(self, it: int, generation: int, fault) -> int:
+        """Leader-only: atomically publish the generation manifest —
+        the ONE file whose content defines what exists. Patched by
+        the chaos harness's kill_process_at_generation injector
+        (smk_tpu/testing/faults.py): the window after this call's
+        shards landed and before it returns is exactly the torn
+        generation the two-phase commit protects."""
+        attempts, dead, dom_map, dom_attempts, dom_dead = fault
+        rows = np.asarray(
+            [[a, b] for a, b in self.layout.row_ranges], np.int64
+        )
+        return save_pytree(
+            self.path,
+            {
+                "version": np.asarray([DIST_CKPT_VERSION], np.int64),
+                "generation": np.asarray([generation], np.int64),
+                "it": np.asarray([it], np.int64),
+                "meta": self.meta,
+                "ident": self.ident,
+                "seg_base": np.asarray([self.seg_base], np.int64),
+                "n_segments": np.asarray(
+                    [self.n_segments], np.int64
+                ),
+                "filled": np.asarray([self.filled], np.int64),
+                "n_processes": np.asarray(
+                    [self.layout.n_processes], np.int64
+                ),
+                "shard_rows": rows,
+                "fault_attempts": np.asarray(attempts, np.int64),
+                "fault_dead": np.asarray(dead, np.int64),
+                "fault_domain": np.asarray(dom_map, np.int64),
+                "fault_domain_attempts": np.asarray(
+                    dom_attempts, np.int64
+                ),
+                "fault_domain_dead": np.asarray(dom_dead, np.int64),
+            },
+        )
+
+    def _commit(self, state_np, seg, it: int, fault) -> None:
+        """One boundary's full two-phase commit (module docstring).
+        Phase 1: land this process's shard files. Phase 2: barrier,
+        leader publishes the manifest, barrier, old state shard
+        unlinked."""
+        gen = self.generation + 1
+        t0 = monotonic()
+        nbytes = save_pytree(
+            shard_state_path(self.path, self.pid, gen),
+            {
+                "state": state_np,
+                "rows": np.asarray(self.layout.rows, np.int64),
+                "generation": np.asarray([gen], np.int64),
+            },
+        )
+        if seg is not None:
+            param, w, start, stop = seg
+            if stop > start:
+                nbytes += save_segment(
+                    self._seg_prefix,
+                    self.seg_base + self.n_segments,
+                    param, w, start, stop,
+                )
+                self.n_segments += 1
+                self.filled = stop
+        t_land = monotonic()
+        try:
+            self._barrier(
+                f"smk-ckpt-land-g{gen}",
+                timeout_s=self.commit_timeout_s,
+            )
+            if self.layout.is_leader:
+                nbytes += self._publish_manifest(it, gen, fault)
+            self._barrier(
+                f"smk-ckpt-pub-g{gen}",
+                timeout_s=self.commit_timeout_s,
+            )
+        except CollectiveTimeoutError as e:
+            # a dead/hung peer: typed commit abort — the previous
+            # generation stays published (anything else, e.g. the
+            # chaos harness's SimulatedKill, propagates as-is)
+            raise CkptCommitError(
+                f"generation {gen} commit failed: {e}"
+            ) from e
+        self.generation = gen
+        try:
+            os.remove(shard_state_path(self.path, self.pid, gen - 1))
+        except OSError:
+            pass
+        t1 = monotonic()
+        if self.pstats is not None:
+            self.pstats.add_ckpt_write(t_land - t0, nbytes)
+            self.pstats.add_ckpt_commit(
+                t1 - t_land, generation=gen, it=int(it),
+                filled=int(self.filled),
+                n_processes=self.layout.n_processes,
+            )
+
+    def _commit_full(self, state_np, param_local, w_local,
+                     it: int, filled: int, fault=None) -> None:
+        """Full per-process rewrite: ONE merged local segment at a
+        fresh index + a fresh generation — the elastic-rebase /
+        degraded-recovery / hole-refill publication path. Same
+        never-touch-published-files discipline as v7's _write_full,
+        per process. SPMD: every process of the job executes this in
+        lockstep (the executor's plan is identical everywhere), so
+        the leader's published counters describe every process's
+        chain."""
+        gen = self.generation + 1
+        t0 = monotonic()
+        old = list(
+            range(self.seg_base, self.seg_base + self.n_segments)
+        )
+        new_base = self.seg_base + self.n_segments
+        self.seg_base = new_base
+        self.n_segments = 0
+        self.filled = 0
+        nbytes = save_pytree(
+            shard_state_path(self.path, self.pid, gen),
+            {
+                "state": state_np,
+                "rows": np.asarray(self.layout.rows, np.int64),
+                "generation": np.asarray([gen], np.int64),
+            },
+        )
+        if filled > 0:
+            nbytes += save_segment(
+                self._seg_prefix, new_base, param_local, w_local,
+                0, filled,
+            )
+            self.n_segments = 1
+            self.filled = filled
+        t_land = monotonic()
+        try:
+            self._barrier(
+                f"smk-ckpt-land-g{gen}",
+                timeout_s=self.commit_timeout_s,
+            )
+            if self.layout.is_leader:
+                nbytes += self._publish_manifest(
+                    it, gen, fault or self._fault_src()
+                )
+            self._barrier(
+                f"smk-ckpt-pub-g{gen}",
+                timeout_s=self.commit_timeout_s,
+            )
+        except CollectiveTimeoutError as e:
+            raise CkptCommitError(
+                f"generation {gen} full-rewrite commit failed: {e}"
+            ) from e
+        self.generation = gen
+        for i in old:
+            try:
+                os.remove(segment_path(self._seg_prefix, i))
+            except OSError:  # pragma: no cover - cleanup only
+                pass
+        try:
+            os.remove(shard_state_path(self.path, self.pid, gen - 1))
+        except OSError:
+            pass
+        t1 = monotonic()
+        if self.pstats is not None:
+            self.pstats.add_ckpt_write(t_land - t0, nbytes)
+            self.pstats.add_ckpt_commit(
+                t1 - t_land, generation=gen, it=int(it),
+                filled=int(self.filled),
+                n_processes=self.layout.n_processes,
+            )
+
+    # -- boundary entry points (caller thread) ---------------------
+
+    def _check_degrade(self) -> None:
+        if (
+            self.writer is not None
+            and not self.degraded
+            and self.writer.error is not None
+        ):
+            err = self.writer.acknowledge_error()
+            if self.layout.n_processes > 1:
+                # a LOCAL writer failure on a multi-process job
+                # cannot degrade unilaterally: this process would
+                # compact (re-basing ITS chain) while the leader's
+                # manifest counters keep describing everyone else's
+                # — and its missing shard lands already stalled the
+                # peers' commit barriers anyway. Abort typed; the
+                # last COMMITTED generation is intact, resume from
+                # it (elastically if this host's disk is gone).
+                raise CkptCommitError(
+                    "background distributed-checkpoint writer "
+                    f"failed on process {self.pid} ({err!r}); a "
+                    "multi-process job cannot degrade one host's "
+                    "chain unilaterally — aborting; resume from "
+                    "the last committed generation"
+                )
+            warnings.warn(
+                f"background distributed-checkpoint writer failed "
+                f"({err!r}); degrading to synchronous commits — the "
+                "next boundary rewrites this process's full shard "
+                "and publishes a fresh generation",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+            self.writer.flush()
+            self.degraded = True
+            self._need_full = True
+
+    def save(self, state_src, seg_src, it: int, filled: int) -> None:
+        """Persist one chunk boundary as one generation (API mirror
+        of _SegmentedCheckpoint.save; sources come from
+        :meth:`snapshot`)."""
+        if self._suspend_appends:
+            # elastic-with-holes resume: the chain on disk still
+            # belongs to the WRITING topology and stays the
+            # resumable truth until the refill publication
+            # (rewrite_full_from_device) re-establishes one under
+            # the current layout — an append here would publish a
+            # manifest whose counters mix the two
+            if not self._warned_suspended:
+                self._warned_suspended = True
+                warnings.warn(
+                    "distributed checkpoint: per-boundary commits "
+                    "are suspended during this elastic lenient "
+                    "(hole) resume — the previous topology's "
+                    "committed generations remain the resumable "
+                    "truth until the post-refill publication "
+                    "re-bases the chain (a crash before then "
+                    "repeats this resume)",
+                    RuntimeWarning,
+                    stacklevel=3,
+                )
+            return
+        self._check_degrade()
+        state_np = self._materialize(state_src)
+        seg = None
+        if seg_src is not None:
+            draws, start, stop = seg_src
+            param, w = self._materialize(draws)
+            seg = (param, w, start, stop)
+        fault = self._fault_src()
+        if self.writer is not None and not self.degraded:
+            self.writer.submit(
+                lambda: self._commit(state_np, seg, it, fault)
+            )
+            return
+        if self._need_full:
+            param, w = self._local_draws(filled)
+            self._commit_full(state_np, param, w, it, filled)
+            self._need_full = False
+            return
+        if self.run_log is not None:
+            # sync mode runs the commit on the caller thread, where
+            # the span stack is safe to nest into (the overlap
+            # writer thread emits the per-generation EVENT only —
+            # RunLog spans are a caller-side stack)
+            with self.run_log.span(
+                "ckpt_commit", generation=self.generation + 1
+            ):
+                self._commit(state_np, seg, it, fault)
+            return
+        self._commit(state_np, seg, it, fault)
+
+    def ensure_synced(self, state_live, it: int, filled: int) -> None:
+        """Drain the writer; re-establish a consistent generation
+        inline if any background commit was lost."""
+        if self._suspend_appends:
+            return  # the old topology's chain stands (see save())
+        if self.writer is None:
+            return
+        self.writer.flush()
+        if self.writer.error is not None and not self.degraded:
+            self._check_degrade()
+        if self._need_full:
+            state_np = local_tree_np(
+                state_live, tag="checkpoint_full_rewrite"
+            )
+            param, w = self._local_draws(filled)
+            self._commit_full(state_np, param, w, it, filled)
+            self._need_full = False
+
+    def rewrite_full_from_device(
+        self, state_live, param_local, w_local, it: int, filled: int
+    ) -> None:
+        """Inline full rewrite from live device state + pre-fetched
+        LOCAL draw rows — the hole-refill completion publication
+        (lenient resume re-sampled torn ranges out of order; one
+        merged per-process segment + a fresh generation now publishes
+        the verified region)."""
+        if self.writer is not None and not self._suspend_appends:
+            self.writer.flush()
+            if self.writer.error is not None:
+                self._check_degrade()
+        state_np = local_tree_np(
+            state_live, tag="checkpoint_full_rewrite"
+        )
+        # the refill publication also ENDS an elastic-with-holes
+        # append suspension: from here the chain belongs to the
+        # current layout
+        self._suspend_appends = False
+        self._commit_full(state_np, param_local, w_local, it, filled)
+        self._need_full = False
+
+    # -- resume ----------------------------------------------------
+
+    def _warn_orphans(self, generation: int, prev_rows) -> None:
+        """Detect shard files of a TORN generation (landed after the
+        last published manifest — the crash window between shard-land
+        and manifest-publish). They are overwritten when the resumed
+        run re-commits those names; surfacing them makes the rollback
+        observable."""
+        torn = []
+        for p in range(len(prev_rows)):
+            if os.path.exists(
+                shard_state_path(self.path, p, generation + 1)
+            ):
+                torn.append(p)
+        nxt = self.seg_base + self.n_segments
+        for p in range(len(prev_rows)):
+            if os.path.exists(
+                segment_path(shard_segment_prefix(self.path, p), nxt)
+            ):
+                if p not in torn:
+                    torn.append(p)
+        if torn:
+            dmap = FailureDomainMap.from_shard_rows(prev_rows)
+            warnings.warn(
+                f"checkpoint {self.path}: orphan shard files of torn "
+                f"generation {generation + 1} found for "
+                f"{[dmap.labels[p] for p in sorted(torn)]} — a "
+                "previous run crashed between shard-land and "
+                "manifest-publish; resuming from the last COMMITTED "
+                f"generation {generation} (the orphans are "
+                "overwritten as the resumed run reaches that "
+                "boundary again)",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+
+    def load(
+        self,
+        state_like,
+        dtype,
+        *,
+        n_kept: int,
+        lead: tuple,
+        d_par: int,
+        d_w: int,
+        lenient: bool,
+        sharding=None,
+    ) -> dict:
+        """Load the last committed generation.
+
+        Returns a dict with ``it``/``holes``/``assembled`` plus the
+        carried state and full-capacity draw accumulators — DEVICE
+        arrays under the canonical ``sharding`` when the topology
+        matches the manifest (each process loads only its own
+        shards), host numpy full-K arrays otherwise (the ELASTIC
+        path: shards re-gathered; the executor re-shards them), and
+        the persisted fault bookkeeping under the v7 key names so
+        the executor's adoption logic is shared."""
+        try:
+            man = load_pytree(self.path, _manifest_like())
+        except ValueError as e:
+            raise ValueError(
+                f"checkpoint {self.path} does not match the "
+                f"distributed checkpoint format v{DIST_CKPT_VERSION} "
+                "(per-host shard files + one generation manifest; "
+                "single-host v5-v7 files load through the unmeshed "
+                "executor path) — delete the file or pass a fresh "
+                "checkpoint_path"
+            ) from e
+        version = int(np.asarray(man["version"])[0])
+        if version != DIST_CKPT_VERSION:
+            raise ValueError(
+                f"checkpoint {self.path} has distributed format "
+                f"version {version}, expected {DIST_CKPT_VERSION} — "
+                "delete the file or re-run"
+            )
+        if not np.array_equal(np.asarray(man["meta"]), self.meta):
+            raise ValueError(
+                f"checkpoint {self.path} was written for a different "
+                f"run: meta {np.asarray(man['meta'])} vs expected "
+                f"{self.meta}"
+            )
+        if not np.array_equal(np.asarray(man["ident"]), self.ident):
+            raise ValueError(
+                f"checkpoint {self.path} was written for a different "
+                "run: cross-host config/key/data fingerprint "
+                "mismatch — same shapes, different chain, OR a "
+                "checkpoint from an older build (the fingerprint "
+                "covers the full config schema, so a build that "
+                "added config fields invalidates older files) — "
+                "delete the file or pass a different checkpoint_path"
+            )
+        gen = int(np.asarray(man["generation"])[0])
+        it = int(np.asarray(man["it"])[0])
+        self.seg_base = int(np.asarray(man["seg_base"])[0])
+        self.n_segments = int(np.asarray(man["n_segments"])[0])
+        self.filled = int(np.asarray(man["filled"])[0])
+        self.generation = gen
+        prev_rows = [
+            (int(a), int(b))
+            for a, b in np.asarray(man["shard_rows"])
+        ]
+        self._warn_orphans(gen, prev_rows)
+        same_topology = (
+            tuple(prev_rows) == tuple(self.layout.row_ranges)
+        )
+        if not same_topology:
+            dmap = FailureDomainMap.from_shard_rows(prev_rows)
+            warnings.warn(
+                "elastic resume: the checkpoint was written by "
+                f"{len(prev_rows)} process(es) "
+                f"(shard owners {list(dmap.labels)}) but the current "
+                f"topology has {self.layout.n_processes} — all "
+                "shards are re-gathered and re-sharded under the "
+                "current layout (surviving subsets' chains are "
+                "untouched: subset draws depend only on data and "
+                "keys); the per-domain retry ladders reset "
+                "(parallel/recovery.py)",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+        read_rows = (
+            [self.layout.rows] if same_topology else prev_rows
+        )
+        read_pids = (
+            [self.pid] if same_topology else list(range(len(prev_rows)))
+        )
+        # -- carried state shards ---------------------------------
+        state_parts = []
+        for p, (a, b) in zip(read_pids, read_rows):
+            sp = shard_state_path(self.path, p, gen)
+            local_like = {
+                "state": jax.tree_util.tree_map(
+                    lambda s, a=a, b=b: jax.ShapeDtypeStruct(
+                        (b - a,) + tuple(s.shape[1:]), s.dtype
+                    ),
+                    state_like,
+                ),
+                "rows": np.zeros(2, np.int64),
+                "generation": np.zeros(1, np.int64),
+            }
+            import zipfile
+
+            try:
+                shard = load_pytree(sp, local_like)
+            except (
+                OSError, ValueError, KeyError, zipfile.BadZipFile,
+            ) as e:
+                dmap = FailureDomainMap.from_shard_rows(prev_rows)
+                raise ValueError(
+                    f"checkpoint {self.path}: carried-state shard "
+                    f"{sp} (owner {dmap.labels[p]}, subset rows "
+                    f"[{a}, {b})) of committed generation {gen} is "
+                    "missing or unreadable — a committed "
+                    "generation's shards all existed at publish "
+                    "time (two-phase commit), so the file was "
+                    "damaged after the fact; restore it or delete "
+                    "the checkpoint and re-run"
+                ) from e
+            if int(np.asarray(shard["generation"])[0]) != gen or not (
+                np.array_equal(
+                    np.asarray(shard["rows"]), np.asarray((a, b))
+                )
+            ):
+                raise ValueError(
+                    f"checkpoint {self.path}: state shard {sp} "
+                    "records a different generation/row range than "
+                    "the manifest — the file set is inconsistent"
+                )
+            state_parts.append(shard["state"])
+        if len(state_parts) == 1:
+            state_np = state_parts[0]
+        else:
+            state_np = jax.tree_util.tree_map(
+                lambda *xs: np.concatenate(
+                    [np.asarray(x) for x in xs], axis=0
+                ),
+                *[
+                    jax.tree_util.tree_map(
+                        lambda l: np.asarray(
+                            jax.random.key_data(l)
+                            if is_key_leaf(l) else l
+                        ),
+                        part,
+                    )
+                    for part in state_parts
+                ],
+            )
+            # raw key leaves re-wrap against the like's key dtype
+            state_np = jax.tree_util.tree_map(
+                lambda raw, ref: (
+                    jax.random.wrap_key_data(raw)
+                    if is_key_leaf(ref) else raw
+                ),
+                state_np, state_like,
+            )
+        # -- draw segments ----------------------------------------
+        holes: List[Tuple[int, int]] = []
+        param_np = w_np = None
+        if self.filled > 0:
+            if same_topology:
+                a, b = self.layout.rows
+                param_np, w_np, holes = self._read_own_segments(
+                    self.pid, (a, b), dtype, lead, d_par, d_w,
+                    lenient,
+                )
+                holes = self._agree_holes(holes)
+            else:
+                parts_p, parts_w = [], []
+                for p, (a, b) in zip(read_pids, read_rows):
+                    pp, ww, hs = self._read_own_segments(
+                        p, (a, b), dtype, lead, d_par, d_w, lenient,
+                    )
+                    parts_p.append(pp)
+                    parts_w.append(ww)
+                    holes = _union_ranges(holes + hs)
+                param_np = np.concatenate(parts_p, axis=0)
+                w_np = np.concatenate(parts_w, axis=0)
+        fault = {
+            name: np.asarray(man[name], np.int64)
+            for name in (
+                "fault_attempts", "fault_dead", "fault_domain",
+                "fault_domain_attempts", "fault_domain_dead",
+            )
+        }
+        # -- elastic chain re-base (review hardening) -------------
+        # The loaded segment counters describe the WRITING
+        # topology's per-host chains; appending the current layout's
+        # boundaries on top of them would publish manifests whose
+        # scalar counters mix the two, and a later resume would
+        # misread (or re-sample) committed draws. With everything
+        # gathered cleanly, each CURRENT process immediately
+        # publishes a fresh full generation of its own slice — the
+        # old files become harmless superseded orphans. With HOLES,
+        # per-boundary appends are instead SUSPENDED until the
+        # refill publication re-bases the chain (save()); a crash
+        # before then simply repeats this elastic resume.
+        if not same_topology:
+            fault_tuple = (
+                fault["fault_attempts"], fault["fault_dead"],
+                fault["fault_domain"],
+                fault["fault_domain_attempts"],
+                fault["fault_domain_dead"],
+            )
+            if holes:
+                self._suspend_appends = True
+            else:
+                a, b = self.layout.rows
+                state_local = jax.tree_util.tree_map(
+                    lambda l: l[a:b], state_np
+                )
+                self._commit_full(
+                    state_local,
+                    None if param_np is None else param_np[a:b],
+                    None if w_np is None else w_np[a:b],
+                    it,
+                    self.filled if param_np is not None else 0,
+                    fault=fault_tuple,
+                )
+        # -- placement --------------------------------------------
+        assembled = False
+        state_out = state_np
+        param_out, w_out = param_np, w_np
+        if same_topology and sharding is not None:
+            assembled = True
+            state_out = _assemble_tree(
+                state_np, state_like, sharding, self.layout.k
+            )
+            if param_np is not None:
+                pad = n_kept - param_np.shape[-2]
+                if pad:
+                    padding = (
+                        [(0, 0)] * (param_np.ndim - 2)
+                        + [(0, pad), (0, 0)]
+                    )
+                    param_np = np.pad(param_np, padding)
+                    w_np = np.pad(w_np, padding)
+                param_out = _assemble_leaf(
+                    np.asarray(param_np, dtype), sharding,
+                    self.layout.k,
+                )
+                w_out = _assemble_leaf(
+                    np.asarray(w_np, dtype), sharding, self.layout.k
+                )
+        return {
+            "it": it,
+            "generation": gen,
+            "holes": holes,
+            "assembled": assembled,
+            "same_topology": same_topology,
+            "state": state_out,
+            "param": param_out,
+            "w": w_out,
+            "prev_shard_rows": prev_rows,
+            **fault,
+        }
+
+    def _read_own_segments(
+        self, pid, rows, dtype, lead, d_par, d_w, lenient
+    ):
+        """One process's segment chain, assembled to its local row
+        block. Lenient mode turns every unreadable/corrupt/
+        inconsistent segment into an ITERATION-range hole (the
+        cross-host union is re-sampled by fill chunks across ALL
+        subsets — coarser than the lost rows, but fill programs are
+        whole-K dispatches); strict mode raises v7-style.
+
+        NOTE this deliberately MIRRORS recovery._read_segments /
+        _read_segments_lenient (the v5-v7 whole-K readers) with
+        per-prefix paths and local leads — a validation fix there
+        (new corruption class, bounds rule) must land here too;
+        keeping the golden-pinned v7 readers untouched was chosen
+        over extracting a shared loop mid-PR."""
+        import zipfile
+
+        a, b = rows
+        lead_local = (b - a,) + tuple(lead[1:])
+        prefix = shard_segment_prefix(self.path, pid)
+        param = np.zeros(lead_local + (self.filled, d_par), dtype)
+        w = np.zeros(lead_local + (self.filled, d_w), dtype)
+        covered = np.zeros(self.filled, bool)
+        for i in range(self.seg_base, self.seg_base + self.n_segments):
+            try:
+                seg = load_segment(prefix, i)
+            except (
+                OSError, KeyError, ValueError, zipfile.BadZipFile,
+            ) as e:
+                if not lenient:
+                    raise ValueError(
+                        f"checkpoint {self.path} is missing or has a "
+                        "corrupt draw segment "
+                        f"{segment_path(prefix, i)} (process {pid}'s "
+                        f"shard) — the manifest records "
+                        f"{self.n_segments} segments covering "
+                        f"{self.filled} kept draws; restore the "
+                        "file, delete the checkpoint, or resume "
+                        "under fault_policy='quarantine' to "
+                        "re-sample the range"
+                    ) from e
+                warnings.warn(
+                    f"checkpoint {self.path}: draw segment "
+                    f"{segment_path(prefix, i)} (shard of process "
+                    f"{pid}, subset rows [{a}, {b})) is corrupt or "
+                    f"unreadable ({e!r}); its iteration range will "
+                    "be re-sampled across all subsets "
+                    "(fault_policy='quarantine' lenient resume)",
+                    RuntimeWarning,
+                    stacklevel=4,
+                )
+                continue
+            sa, sb = seg["start"], seg["stop"]
+            if (
+                not 0 <= sa < sb <= self.filled
+                or seg["param"].shape[-2] != sb - sa
+                or seg["w"].shape[-2] != sb - sa
+                or seg["param"].shape[:-2] != lead_local
+                or seg["param"].shape[-1] != d_par
+                or seg["w"].shape[-1] != d_w
+                or covered[sa:sb].any()
+            ):
+                if not lenient:
+                    raise ValueError(
+                        f"checkpoint {self.path} segment "
+                        f"{segment_path(prefix, i)} records range "
+                        f"[{sa}, {sb}) inconsistent with the "
+                        "manifest (shape/bounds/overlap)"
+                    )
+                warnings.warn(
+                    f"checkpoint {self.path}: draw segment "
+                    f"{segment_path(prefix, i)} records range "
+                    f"[{sa}, {sb}) inconsistent with the manifest; "
+                    "treating it as corrupt — its range will be "
+                    "re-sampled",
+                    RuntimeWarning,
+                    stacklevel=4,
+                )
+                continue
+            param[..., sa:sb, :] = np.asarray(seg["param"], dtype)
+            w[..., sa:sb, :] = np.asarray(seg["w"], dtype)
+            covered[sa:sb] = True
+        holes = _ranges_of(~covered)
+        if holes and not lenient:
+            raise ValueError(
+                f"checkpoint {self.path}: process {pid}'s segments "
+                f"cover only part of the recorded {self.filled} kept "
+                f"draws (holes {holes})"
+            )
+        return param, w, holes
+
+    def _agree_holes(self, local_holes):
+        """Cross-host agreement on the hole set: a torn shard on ONE
+        host must become the SAME fill plan on every host (fill
+        chunks are collective whole-K dispatches). Bounded by the
+        commit deadline."""
+        payload = np.asarray(
+            local_holes, np.int64
+        ).reshape(-1).astype("<i8").tobytes()
+        gathered = allgather_bytes(
+            "ckpt-holes", payload, timeout_s=self.commit_timeout_s
+        )
+        merged = list(local_holes)
+        for buf in gathered:
+            arr = np.frombuffer(buf, dtype="<i8").reshape(-1, 2)
+            merged.extend((int(x), int(y)) for x, y in arr)
+        return _union_ranges(merged)
+
+
+def _ranges_of(mask: np.ndarray) -> List[Tuple[int, int]]:
+    """Sorted disjoint (start, stop) ranges of True runs."""
+    out: List[Tuple[int, int]] = []
+    pos = 0
+    n = len(mask)
+    while pos < n:
+        if not mask[pos]:
+            pos += 1
+            continue
+        start = pos
+        while pos < n and mask[pos]:
+            pos += 1
+        out.append((start, pos))
+    return out
+
+
+def _union_ranges(ranges) -> List[Tuple[int, int]]:
+    """Sorted union of half-open ranges."""
+    out: List[Tuple[int, int]] = []
+    for a, b in sorted(set((int(a), int(b)) for a, b in ranges)):
+        if out and a <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], b))
+        else:
+            out.append((a, b))
+    return out
+
+
+def _assemble_leaf(local_np: np.ndarray, sharding, k: int):
+    """One process-local row block back onto the mesh under the
+    canonical sharding — the same-topology resume's device_put (no
+    gather, no reshard; jax assembles the global array from each
+    process's local data)."""
+    global_shape = (k,) + tuple(local_np.shape[1:])
+    return jax.make_array_from_process_local_data(
+        sharding, np.ascontiguousarray(local_np), global_shape
+    )
+
+
+def _assemble_tree(tree_np, like, sharding, k: int):
+    """Assemble a whole local-row state tree; typed PRNG key leaves
+    route through raw key data (multi-host assembly rejects
+    PRNGKeyArray, the same convention as the executor's put)."""
+    def one(leaf, ref):
+        if is_key_leaf(ref):
+            raw = np.asarray(
+                jax.random.key_data(leaf)
+                if is_key_leaf(leaf) else leaf
+            )
+            return jax.random.wrap_key_data(
+                _assemble_leaf(raw, sharding, k)
+            )
+        return _assemble_leaf(np.asarray(leaf), sharding, k)
+
+    return jax.tree_util.tree_map(one, tree_np, like)

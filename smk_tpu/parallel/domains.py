@@ -233,6 +233,36 @@ class FailureDomainMap:
         )
 
     @classmethod
+    def from_shard_rows(cls, shard_rows) -> "FailureDomainMap":
+        """Domain map from a v8 checkpoint manifest's shard-ownership
+        table (parallel/checkpoint.py, ISSUE 13): ``shard_rows`` is
+        the (P, 2) per-process ``(start, stop)`` subset-row ranges
+        the WRITING topology persisted under, and the map it induces
+        — one domain per writing process, labeled ``shard:p`` —
+        attributes every shard file to the host that owned it. This
+        is how an elastic resume names WHICH dead host's shards it is
+        re-laying (the warning and the torn-shard lenient path both
+        speak in these labels), keeping shard ownership and fault
+        attribution one vocabulary."""
+        rows = [(int(a), int(b)) for a, b in np.asarray(shard_rows)]
+        if not rows or rows[0][0] != 0:
+            raise ValueError(
+                f"shard_rows {rows} do not start at subset 0"
+            )
+        doms = []
+        for p, (a, b) in enumerate(rows):
+            if b <= a or a != len(doms):
+                raise ValueError(
+                    f"shard_rows {rows} are not a contiguous "
+                    "partition of the subset axis"
+                )
+            doms.extend([p] * (b - a))
+        return cls(
+            domain_of_subset=tuple(doms),
+            labels=tuple(f"shard:{p}" for p in range(len(rows))),
+        )
+
+    @classmethod
     def derive(cls, k: int, mesh=None) -> "FailureDomainMap":
         """The executor's default derivation: a multi-process mesh
         yields the process-granular map (host = blast radius of a

@@ -350,6 +350,55 @@ def subset_device_assignment(k: int, mesh: Mesh) -> list:
     return [devs[i // per] for i in range(k)]
 
 
+def all_process_row_ranges(k: int, mesh: Mesh) -> list:
+    """Contiguous K-row ownership per process under the canonical
+    1-D leading-K layout (:func:`subset_device_assignment`): entry
+    ``p`` is the ``(start, stop)`` subset-row range addressable by
+    the job's ``p``-th process (processes ordered by ascending
+    ``process_index``). This is the shard-ownership half of the
+    layout oracle — the distributed checkpoint's per-host shard
+    files (parallel/checkpoint.py, ISSUE 13) and the failure-domain
+    attribution both derive from it, so a layout change cannot
+    silently desynchronize what a host *persists* from what it
+    *executes*. Raises if any process's rows are non-contiguous
+    (impossible under the canonical layout; a loud error beats a
+    torn shard file)."""
+    devices = subset_device_assignment(k, mesh)
+    procs = sorted({int(getattr(d, "process_index", 0)) for d in devices})
+    out = []
+    for p in procs:
+        rows = [
+            i for i, d in enumerate(devices)
+            if int(getattr(d, "process_index", 0)) == p
+        ]
+        start, stop = rows[0], rows[-1] + 1
+        if rows != list(range(start, stop)):
+            raise ValueError(
+                f"process {p} owns non-contiguous subset rows "
+                f"{rows} — the canonical contiguous leading-K "
+                "layout is a prerequisite of per-host shard "
+                "checkpointing (parallel/checkpoint.py)"
+            )
+        out.append((start, stop))
+    return out
+
+
+def process_row_range(k: int, mesh: Mesh) -> tuple:
+    """THIS process's ``(start, stop)`` contiguous subset-row
+    ownership under the canonical layout — the rows whose carried
+    state and draw-accumulator shards are addressable here (see
+    :func:`all_process_row_ranges`)."""
+    devices = subset_device_assignment(k, mesh)
+    procs = sorted({int(getattr(d, "process_index", 0)) for d in devices})
+    me = int(jax.process_index())
+    if me not in procs:  # pragma: no cover - defensive
+        raise ValueError(
+            f"process {me} owns no device of this mesh (processes "
+            f"{procs}) — it cannot participate in the sharded fit"
+        )
+    return all_process_row_ranges(k, mesh)[procs.index(me)]
+
+
 def make_mesh(n_devices: Optional[int] = None, axis: str = "subsets") -> Mesh:
     """1-D device mesh over the subset axis (ICI on a real slice).
     An ``n_devices`` exceeding the visible device count is an error,

@@ -34,6 +34,7 @@ import numpy as np
 
 from smk_tpu.analysis.sanitizers import explicit_d2h
 from smk_tpu.compile import programs as compile_programs
+from smk_tpu.parallel import checkpoint as dist_ckpt
 from smk_tpu.parallel.domains import ChunkWatchdog, FailureDomainMap
 from smk_tpu.models.probit_gp import (
     SpatialGPSampler,
@@ -89,6 +90,14 @@ from smk_tpu.utils.tracing import ChunkPipelineStats, monotonic
 # and resets the domain ladders while per-subset deaths persist. A
 # bump invalidates older files with a clear error instead of a
 # generic structure mismatch.
+#
+# v8 — the DISTRIBUTED sharded-generation layout (ISSUE 13) — lives
+# in parallel/checkpoint.py (DIST_CKPT_VERSION): per-host shard
+# files, two-phase-committed generations, elastic multi-host resume.
+# It is selected only under a multi-process mesh (or when resuming a
+# file that already is a v8 manifest); single-host checkpoints keep
+# THIS format byte-identically, which is why the constant below does
+# not bump.
 CKPT_VERSION = 7
 
 
@@ -364,37 +373,12 @@ def _run_identity(cfg, key, data, beta_init) -> np.ndarray:
     the operational escape hatch when the quarantine engine itself
     misbehaves — the manifest's fault bookkeeping rides along either
     way."""
-    import dataclasses
-
-    cfg_ident = dataclasses.replace(
-        cfg,
-        chunk_pipeline="sync",
-        fault_policy="abort",
-        fault_max_retries=2,
-        min_surviving_frac=0.5,
-        # the AOT program store changes WHERE executables come from,
-        # never the chain (a loaded executable is the same machine
-        # code) — resuming with/without a store must be legal
-        compile_store_dir=None,
-        xla_cache_dir=None,
-        # observability (ISSUE 10) watches the chain, never steers it
-        # — a run checkpointed with the run log / live diagnostics /
-        # profiler armed must resume with them off and vice versa
-        run_log_dir=None,
-        live_diagnostics=False,
-        profile_dir=None,
-        profile_chunks=None,
-        # host-resilience knobs (ISSUE 11): the watchdog only watches
-        # and the distributed bring-up only retries — a run
-        # checkpointed guarded must resume unguarded (and on a
-        # different topology) and vice versa
-        watchdog=False,
-        watchdog_min_deadline_s=60.0,
-        watchdog_margin=10.0,
-        dist_init_timeout_s=120.0,
-        dist_init_retries=3,
-    )
-    crcs = [zlib.crc32(repr(cfg_ident).encode())]
+    # the ONE neutralization set — store/obs/host-resilience/commit
+    # knobs fixed to defaults — lives in
+    # parallel/checkpoint.identity_config_repr, shared byte-for-byte
+    # with the v8 distributed identity scheme so the two can never
+    # drift on which knobs are resume-legal to change
+    crcs = [zlib.crc32(dist_ckpt.identity_config_repr(cfg))]
     crcs.append(zlib.crc32(_key_bytes(key)))
     for leaf in jax.tree_util.tree_leaves(data):
         crcs.append(_leaf_fingerprint(leaf))
@@ -845,6 +829,19 @@ class _SegmentedCheckpoint:
 
     # ---- boundary entry point (caller thread) --------------------
 
+    def snapshot(self, tree):
+        """(source, d2h_bytes) for one boundary's to-be-donated tree
+        — the v7 policy exactly as the executor historically inlined
+        it: an async :class:`HostSnapshot` under the overlap pipeline
+        (``writer`` set), the live tree (materialized at save time,
+        before the next dispatch) under sync. Mirrored by the v8
+        DistributedCheckpoint.snapshot (addressable shards only), so
+        boundary_record is format-agnostic."""
+        if self.writer is not None:
+            snap = HostSnapshot(tree)
+            return snap, snap.nbytes
+        return tree, tree_nbytes(tree)
+
     def _check_degrade(self) -> None:
         if (
             self.writer is not None
@@ -1245,35 +1242,43 @@ def _fit_subsets_chunked_impl(
         [cfg.n_samples, cfg.n_burn_in, k, d_par, d_w, cfg.n_chains],
         np.int64,
     )
-    # On a MULTI-PROCESS mesh (ISSUE 12) the run-identity fingerprint
-    # cannot be computed: it samples every data leaf to host, and the
-    # shards of a globally-sharded leaf are not all addressable from
-    # one process. The fingerprint exists only to guard checkpoints,
-    # so the checkpoint-free scale-out path skips it (single-host
-    # runs keep computing it unconditionally — the sanctioned
-    # `run_identity` D2H tag is part of the pinned transfer ledger of
-    # the chaos/obs protocols), and checkpointing itself is a typed
-    # unsupported error on a multi-process mesh instead of a deep
-    # non-addressable-fetch crash (the draw segments would need the
-    # same impossible host gather).
+    # Checkpoint format selection (ISSUE 13, parallel/checkpoint.py):
+    # a MULTI-PROCESS mesh routes through the distributed v8 layer —
+    # per-host shard files, two-phase-committed generations — because
+    # the single-host formats would need to host-fetch
+    # globally-sharded accumulators whose shards live on other hosts
+    # (the old typed NotImplementedError). A single-process run also
+    # routes through v8 when the file at checkpoint_path already IS a
+    # v8 manifest: the elastic resume of a multi-host checkpoint onto
+    # one surviving host. Everything else keeps the v7 single-host
+    # path BYTE-identically.
     multi_process_mesh = mesh is not None and len(
         {int(d.process_index) for d in mesh.devices.flat}
     ) > 1
-    if multi_process_mesh and checkpoint_path is not None:
-        raise NotImplementedError(
-            "checkpointing under a multi-process mesh is not "
-            "supported: the per-boundary draw segments require "
-            "host-fetching globally-sharded accumulators whose "
-            "shards live on other hosts. Run the multi-host fit "
-            "without checkpoint_path (subset fits are share-nothing "
-            "— a failed run re-fans out), or checkpoint per-host "
-            "single-process runs."
+    use_v8 = checkpoint_path is not None and (
+        multi_process_mesh
+        or dist_ckpt.FORCE_DISTRIBUTED_FOR_TESTING
+        or (
+            os.path.exists(checkpoint_path)
+            and dist_ckpt.is_distributed_manifest(checkpoint_path)
         )
-    ident = (
-        np.zeros(1, np.uint32)
-        if multi_process_mesh
-        else _run_identity(cfg, key, data, beta_init)
     )
+    if use_v8:
+        # cross-host identity (ISSUE 13 satellite): per-process
+        # digests of the ADDRESSABLE shards, all-gathered and folded
+        # identically everywhere — distributed resumes get the same
+        # wrong-config tripwire single-host runs have (the v7 scheme
+        # skipped multi-process runs entirely)
+        ident = dist_ckpt.distributed_run_identity(
+            cfg, key, data, beta_init,
+            timeout_s=cfg.ckpt_commit_timeout_s,
+        )
+    elif multi_process_mesh:
+        # checkpoint-free scale-out: the fingerprint exists only to
+        # guard checkpoints, so nothing consumes it here
+        ident = np.zeros(1, np.uint32)
+    else:
+        ident = _run_identity(cfg, key, data, beta_init)
     like = {
         "state": init_like,
         "it": np.asarray([0], np.int64),
@@ -1329,25 +1334,139 @@ def _fit_subsets_chunked_impl(
         if (mode == "overlap" and checkpoint_path is not None)
         else None
     )
-    ck = None
-    if checkpoint_path is not None:
-        ck = _SegmentedCheckpoint(
-            checkpoint_path, meta, ident,
-            writer=writer, pstats=pstats,
-            # live-accumulator access for the degraded/compaction
-            # full rewrite: regions beyond `filled` are never read,
-            # so later in-flight chunk writes can't corrupt the slice
-            full_draws=lambda filled: _fetch_draws_slice(
-                param_draws, w_draws, filled
-            ),
-            fault_src=lambda: (
-                attempts.copy(), dead.astype(np.int64),
-                domain_arr.copy(), domain_attempts.copy(),
-                domain_dead.astype(np.int64),
-            ),
+
+    def _fault_snapshot():
+        return (
+            attempts.copy(), dead.astype(np.int64),
+            domain_arr.copy(), domain_attempts.copy(),
+            domain_dead.astype(np.int64),
         )
 
-    if checkpoint_path is not None and os.path.exists(checkpoint_path):
+    ck = None
+    if checkpoint_path is not None:
+        if use_v8:
+            def _local_draws_slice(filled):
+                # the process's ADDRESSABLE rows only — the full
+                # accumulators are fetched (rare: degrade/refill
+                # publication paths) and numpy-sliced to the filled
+                # region, because an eager device slice of a global
+                # array is not a single-process operation
+                pl, wl = dist_ckpt.local_tree_np(
+                    (param_draws, w_draws),
+                    tag="checkpoint_full_rewrite",
+                )
+                return pl[..., :filled, :], wl[..., :filled, :]
+
+            ck = dist_ckpt.DistributedCheckpoint(
+                checkpoint_path, meta, ident,
+                dist_ckpt.ShardLayout.current(k, mesh),
+                writer=writer, pstats=pstats,
+                local_draws=_local_draws_slice,
+                fault_src=_fault_snapshot,
+                commit_timeout_s=cfg.ckpt_commit_timeout_s,
+                run_log=run_log,
+            )
+        else:
+            ck = _SegmentedCheckpoint(
+                checkpoint_path, meta, ident,
+                writer=writer, pstats=pstats,
+                # live-accumulator access for the degraded/compaction
+                # full rewrite: regions beyond `filled` are never
+                # read, so later in-flight chunk writes can't corrupt
+                # the slice
+                full_draws=lambda filled: _fetch_draws_slice(
+                    param_draws, w_draws, filled
+                ),
+                fault_src=_fault_snapshot,
+            )
+
+    def adopt_fault_bookkeeping(src) -> None:
+        """Adopt persisted quarantine/domain bookkeeping from a
+        loaded checkpoint (v7 manifest dict or v8 loader dict — same
+        key names by design). v7 semantics preserved exactly: a
+        same-topology resume adopts the per-domain retry ladders, a
+        DIFFERENT domain topology (elastic resume) re-derives the
+        attribution and resets the ladders while per-subset deaths
+        persist either way."""
+        attempts[:] = np.asarray(src["fault_attempts"], np.int64)
+        dead[:] = np.asarray(src["fault_dead"], np.int64) != 0
+        ck_dom = np.asarray(src["fault_domain"], np.int64)
+        ck_dom_att = np.asarray(
+            src["fault_domain_attempts"], np.int64
+        )
+        ck_dom_dead = np.asarray(src["fault_domain_dead"], np.int64)
+        if (
+            ck_dom.shape[0] == k
+            and np.array_equal(ck_dom, domain_arr)
+            and ck_dom_att.shape[0] == domain_map.n_domains
+        ):
+            domain_attempts[:] = ck_dom_att
+            domain_dead[:] = ck_dom_dead != 0
+        elif (
+            not np.array_equal(ck_dom, domain_arr)
+            or ck_dom_att.shape[0] != domain_map.n_domains
+        ):
+            warnings.warn(
+                "elastic resume: the checkpoint was written under a "
+                f"different failure-domain topology "
+                f"({ck_dom_att.shape[0]} domains) than the current "
+                f"one ({domain_map.n_domains}); surviving subsets "
+                "are re-laid onto the current topology (their chains "
+                "are untouched — subset draws depend only on data "
+                "and keys), per-subset deaths persist, and the "
+                "per-domain retry ladders reset",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+
+    lead = (k,) if cfg.n_chains == 1 else (k, cfg.n_chains)
+    if (
+        checkpoint_path is not None
+        and os.path.exists(checkpoint_path)
+        and use_v8
+    ):
+        # v8 distributed resume (parallel/checkpoint.py): load the
+        # last COMMITTED generation — same topology device_puts each
+        # process's own shards back under the canonical shardings;
+        # a different topology re-gathers and re-shards (warned)
+        loaded = ck.load(
+            init_like, dtype, n_kept=n_kept, lead=lead,
+            d_par=d_par, d_w=d_w, lenient=policy_q, sharding=shard,
+        )
+        it = loaded["it"]
+        if ck.filled != max(0, it - cfg.n_burn_in):
+            raise ValueError(
+                f"checkpoint {checkpoint_path} is inconsistent: "
+                f"manifest covers {ck.filled} kept draws but the "
+                f"iteration counter {it} implies "
+                f"{max(0, it - cfg.n_burn_in)}"
+            )
+        holes = loaded["holes"]
+        adopt_fault_bookkeeping(loaded)
+        state = loaded["state"]
+        if loaded["assembled"]:
+            # same topology: state/draws are already device arrays
+            # under the canonical leading-K NamedShardings
+            if loaded["param"] is not None:
+                param_draws, w_draws = loaded["param"], loaded["w"]
+            else:
+                param_draws, w_draws = empty_draws()
+                if put is not None:
+                    param_draws = put(param_draws)
+                    w_draws = put(w_draws)
+        else:
+            # elastic (or meshless) path: full numpy trees, placed
+            # exactly as a v7 resume would place them
+            if ck.filled > 0:
+                param_draws = to_capacity(loaded["param"])
+                w_draws = to_capacity(loaded["w"])
+            else:
+                param_draws, w_draws = empty_draws()
+            if put is not None:
+                state = put(state)
+                param_draws = put(param_draws)
+                w_draws = put(w_draws)
+    elif checkpoint_path is not None and os.path.exists(checkpoint_path):
         try:
             ckpt = load_pytree(checkpoint_path, like)
         except ValueError as e:
@@ -1383,8 +1502,11 @@ def _fit_subsets_chunked_impl(
             raise ValueError(
                 f"checkpoint {checkpoint_path} was written for a "
                 "different run: config/key/data fingerprint mismatch "
-                "(same shapes, different chain) — delete the file or "
-                "pass a different checkpoint_path"
+                "— same shapes, different chain, OR a checkpoint "
+                "from an older build (the fingerprint covers the "
+                "full config schema, so a build that added config "
+                "fields invalidates older files) — delete the file "
+                "or pass a different checkpoint_path"
             )
         # leaves arrive as numpy (PRNG keys re-wrapped by load_pytree)
         state = ckpt["state"]
@@ -1399,43 +1521,9 @@ def _fit_subsets_chunked_impl(
                 f"iteration counter {it} implies "
                 f"{max(0, it - cfg.n_burn_in)}"
             )
-        attempts[:] = np.asarray(ckpt["fault_attempts"], np.int64)
-        dead[:] = np.asarray(ckpt["fault_dead"], np.int64) != 0
-        # v7 failure-domain bookkeeping: a same-topology resume
-        # adopts the per-domain retry ladders; a DIFFERENT topology
-        # (elastic resume — e.g. fewer hosts after a domain death)
-        # re-derives the attribution onto the current layout and
-        # resets the ladders (the new hosts are new hardware), while
-        # the per-subset deaths above persist either way
-        ck_dom = np.asarray(ckpt["fault_domain"], np.int64)
-        ck_dom_att = np.asarray(
-            ckpt["fault_domain_attempts"], np.int64
-        )
-        ck_dom_dead = np.asarray(ckpt["fault_domain_dead"], np.int64)
-        if (
-            ck_dom.shape[0] == k
-            and np.array_equal(ck_dom, domain_arr)
-            and ck_dom_att.shape[0] == domain_map.n_domains
-        ):
-            domain_attempts[:] = ck_dom_att
-            domain_dead[:] = ck_dom_dead != 0
-        elif (
-            not np.array_equal(ck_dom, domain_arr)
-            or ck_dom_att.shape[0] != domain_map.n_domains
-        ):
-            warnings.warn(
-                "elastic resume: the checkpoint was written under a "
-                f"different failure-domain topology "
-                f"({ck_dom_att.shape[0]} domains) than the current "
-                f"one ({domain_map.n_domains}); surviving subsets "
-                "are re-laid onto the current topology (their chains "
-                "are untouched — subset draws depend only on data "
-                "and keys), per-subset deaths persist, and the "
-                "per-domain retry ladders reset",
-                RuntimeWarning,
-                stacklevel=2,
-            )
-        lead = (k,) if cfg.n_chains == 1 else (k, cfg.n_chains)
+        # v7 failure-domain bookkeeping adoption (shared with the v8
+        # loader — same key names by design)
+        adopt_fault_bookkeeping(ckpt)
         if policy_q:
             # lenient: a corrupt/truncated/checksum-failed segment
             # becomes a hole whose kept-iteration range is re-sampled
@@ -1921,8 +2009,10 @@ def _fit_subsets_chunked_impl(
             # domain with any finite-data subset is not branded dead
             # (its spared subsets survive; only the rest die).
             with explicit_d2h("terminal_guard", nbytes=k):
-                draws_ok = np.asarray(
-                    _subset_draws_finite(param_draws, w_draws)
+                draws_ok = dist_ckpt.fetch_global(
+                    _subset_draws_finite(param_draws, w_draws),
+                    timeout_s=cfg.ckpt_commit_timeout_s,
+                    tag="terminal_guard",
                 )
             spared = [j for j in dropped if draws_ok[j]]
             if spared:
@@ -2012,10 +2102,21 @@ def _fit_subsets_chunked_impl(
         accept = None
         if b["stats"] is not None:
             # the ONE sanctioned guard/report fetch per boundary —
-            # K+4 bytes, declared to transfer_guard_strict
+            # K+4 bytes, declared to transfer_guard_strict. On a
+            # multi-process mesh the (K,) vector is K-sharded across
+            # hosts, so the fetch routes through the bounded
+            # cross-host gather (fetch_global's fast path for
+            # addressable/replicated arrays is np.asarray,
+            # byte-identical to the historical single-host fetch)
             with explicit_d2h("chunk_stats", nbytes=stats_bytes):
-                finite = np.asarray(b["stats"][0])
-                accept = float(np.asarray(b["stats"][1]))
+                finite = dist_ckpt.fetch_global(
+                    b["stats"][0],
+                    timeout_s=cfg.ckpt_commit_timeout_s,
+                )
+                accept = float(dist_ckpt.fetch_global(
+                    b["stats"][1],
+                    timeout_s=cfg.ckpt_commit_timeout_s,
+                ))
             if policy_q:
                 # quarantine replaces the abort guard wholesale: a
                 # rewind skips this boundary's report AND save (the
@@ -2037,8 +2138,16 @@ def _fit_subsets_chunked_impl(
             with explicit_d2h(
                 "streaming_stats", nbytes=stream_nbytes
             ):
-                live_rh = np.asarray(b["live"][0])
-                live_es = np.asarray(b["live"][1])
+                live_rh = dist_ckpt.fetch_global(
+                    b["live"][0],
+                    timeout_s=cfg.ckpt_commit_timeout_s,
+                    tag="streaming_stats",
+                )
+                live_es = dist_ckpt.fetch_global(
+                    b["live"][1],
+                    timeout_s=cfg.ckpt_commit_timeout_s,
+                    tag="streaming_stats",
+                )
             live_vals = (
                 float(np.nanmax(live_rh))
                 if np.isfinite(live_rh).any() else float("nan"),
@@ -2146,23 +2255,19 @@ def _fit_subsets_chunked_impl(
         if live is not None:
             d2h += stream_nbytes
         if ck is not None and kind != "fill":
-            if mode == "overlap":
-                state_src = HostSnapshot(state)
-                d2h += state_src.nbytes
-            else:
-                state_src = state
-                d2h += tree_nbytes(state)
+            # snapshot policy lives on the checkpoint object (v7:
+            # HostSnapshot/full tree; v8: LocalShardSnapshot /
+            # addressable rows only) so this record site is
+            # checkpoint-format-agnostic
+            state_src, nb = ck.snapshot(state)
+            d2h += nb
             if kind == "samp":
                 a, b_ = start - n_burn, filled
                 ofs = _slice_offset(a)
                 sl_p = _slice_draws(param_draws, ofs, b_ - a)
                 sl_w = _slice_draws(w_draws, ofs, b_ - a)
-                if mode == "overlap":
-                    draws = HostSnapshot((sl_p, sl_w))
-                    d2h += draws.nbytes
-                else:
-                    draws = (sl_p, sl_w)
-                    d2h += tree_nbytes(draws)
+                draws, nb = ck.snapshot((sl_p, sl_w))
+                d2h += nb
                 seg_src = (draws, a, b_)
         return {
             "index": index, "phase": phase, "n": n, "it": it_end,
@@ -2299,13 +2404,23 @@ def _fit_subsets_chunked_impl(
         if holes and not truncated and ck is not None:
             # lenient resume refilled one or more corrupt segments'
             # ranges out of order — publish the complete draw region
-            # as ONE merged, checksummed segment + fresh manifest
-            param_np, w_np = _fetch_draws_slice(
-                param_draws, w_draws, n_kept
-            )
-            ck.rewrite_full(
-                state, param_np, w_np, cfg.n_samples, n_kept
-            )
+            # as ONE merged, checksummed segment (per process under
+            # v8) + fresh manifest/generation
+            if use_v8:
+                pl, wl = dist_ckpt.local_tree_np(
+                    (param_draws, w_draws),
+                    tag="checkpoint_full_rewrite",
+                )
+                ck.rewrite_full_from_device(
+                    state, pl, wl, cfg.n_samples, n_kept
+                )
+            else:
+                param_np, w_np = _fetch_draws_slice(
+                    param_draws, w_draws, n_kept
+                )
+                ck.rewrite_full(
+                    state, param_np, w_np, cfg.n_samples, n_kept
+                )
     finally:
         if prof is not None:
             prof.close()

@@ -44,6 +44,20 @@ Host-level injectors (ISSUE 11):
   coordinator error, exercising ``init_distributed``'s
   exponential-backoff retry ladder and its typed error taxonomy.
 
+Distributed-checkpoint injectors (ISSUE 13, parallel/checkpoint.py):
+
+- :func:`kill_process_at_generation` — :class:`SimulatedKill` raised
+  from the generation-manifest publish of a chosen generation, i.e.
+  on exactly one process (the leader — only it publishes) in the
+  crash window AFTER every shard file of the generation landed and
+  the land barrier passed, BEFORE the manifest made the generation
+  real. The two-phase commit's whole contract is that this window
+  rolls back to the previous generation.
+- :func:`torn_shard` — truncate one host's newest draw segment (or
+  its committed state shard) of an on-disk v8 checkpoint: the
+  post-hoc file-damage scenario the lenient cross-host hole handling
+  (quarantine resume) re-samples.
+
 smklint rule SMK108: these APIs may be imported/armed only under
 ``tests/`` and ``scripts/`` — a reference in ``smk_tpu/`` library
 code ships chaos to production fits and is a lint finding.
@@ -336,6 +350,85 @@ def flaky_coordinator(fail_first: int, passthrough: bool = False):
         yield counter
     finally:
         jax.distributed.initialize = real
+
+
+# ---------------------------------------------------------------------------
+# distributed-checkpoint injectors (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+@contextmanager
+def kill_process_at_generation(generation: int):
+    """Arm the distributed crash-window kill: the generation-manifest
+    publish of generation ``generation`` raises
+    :class:`SimulatedKill` INSTEAD of writing the manifest — on the
+    one process that publishes (the leader), after its shard files
+    landed and the land barrier passed. Peers then time out at the
+    publish barrier with a typed
+    :class:`~smk_tpu.parallel.checkpoint.CkptCommitError`
+    (``ckpt_commit_timeout_s``-bounded). On-disk effect: the previous
+    generation stays the published truth and the killed generation's
+    shard files are orphans a resume detects and overwrites — the
+    exact rollback contract the two-phase commit exists for. Yields
+    a counter dict (``{"publishes": n}``)."""
+    from smk_tpu.parallel import checkpoint as _dist
+
+    real = _dist.DistributedCheckpoint._publish_manifest
+    counter = {"publishes": 0}
+
+    def patched(self, it, gen, fault):
+        counter["publishes"] += 1
+        if int(gen) == int(generation):
+            raise SimulatedKill(
+                "chaos: simulated process death between shard-land "
+                f"and manifest-publish of generation {gen}"
+            )
+        return real(self, it, gen, fault)
+
+    _dist.DistributedCheckpoint._publish_manifest = patched
+    try:
+        yield counter
+    finally:
+        _dist.DistributedCheckpoint._publish_manifest = real
+
+
+def torn_shard(
+    path: str, process_id: int, kind: str = "segment"
+) -> str:
+    """Damage ONE host's shard of the newest committed generation of
+    the v8 checkpoint at ``path``: ``kind="segment"`` truncates
+    process ``process_id``'s last draw segment to half (the lenient
+    quarantine resume re-samples its iteration range across all
+    subsets — the cross-host hole path); ``kind="state"`` truncates
+    the process's committed carried-state shard (unrecoverable by
+    construction — resume raises a loud typed error naming the
+    shard's owner). Plain deterministic file surgery on committed
+    files; returns the damaged path. Test-only by SMK108."""
+    from smk_tpu.parallel import checkpoint as _dist
+    from smk_tpu.utils.checkpoint import load_pytree
+
+    man = load_pytree(path, _dist._manifest_like())
+    if kind == "segment":
+        seg_base = int(np.asarray(man["seg_base"])[0])
+        n_seg = int(np.asarray(man["n_segments"])[0])
+        if n_seg < 1:
+            raise ValueError(
+                f"checkpoint {path} has no draw segments to tear"
+            )
+        target = segment_path(
+            _dist.shard_segment_prefix(path, int(process_id)),
+            seg_base + n_seg - 1,
+        )
+    elif kind == "state":
+        gen = int(np.asarray(man["generation"])[0])
+        target = _dist.shard_state_path(path, int(process_id), gen)
+    else:
+        raise ValueError(f"unknown torn_shard kind {kind!r}")
+    with open(target, "rb") as f:
+        data = f.read()
+    with open(target, "wb") as f:
+        f.write(data[: len(data) // 2])
+    return target
 
 
 # ---------------------------------------------------------------------------

@@ -150,6 +150,13 @@ class ChunkPipelineStats:
     ckpt_write_s: float = 0.0
     ckpt_bytes: int = 0
     ckpt_boundary_bytes: List[int] = field(default_factory=list)
+    # distributed-checkpoint commit accounting (ISSUE 13,
+    # parallel/checkpoint.py): generations published this run and
+    # the coordination seconds (commit barriers + manifest publish)
+    # they cost — 0/0.0 on single-host v7 runs, which have no
+    # generations
+    ckpt_generations: int = 0
+    ckpt_commit_s: float = 0.0
     total_wall_s: float = 0.0
     run_log: Any = None
     _lock: threading.Lock = field(
@@ -265,6 +272,31 @@ class ChunkPipelineStats:
             "program_sources": sources,
         }
 
+    def add_ckpt_commit(
+        self, seconds: float, *, generation: int, it: int = -1,
+        filled: int = -1, n_processes: int = 1,
+    ) -> None:
+        """One committed checkpoint GENERATION (ISSUE 13,
+        parallel/checkpoint.py): ``seconds`` is the coordination
+        cost of the two-phase commit — the land/publish barriers
+        plus the leader's manifest write — measured on the writing
+        thread (the shard-file I/O itself rides in
+        ``add_ckpt_write``). Emits one per-generation ``ckpt_commit``
+        event into the run log."""
+        with self._lock:
+            self.ckpt_generations += 1
+            self.ckpt_commit_s += float(seconds)
+            self._emit(
+                "ckpt_commit",
+                {
+                    "generation": int(generation),
+                    "seconds": round(float(seconds), 6),
+                    "it": int(it),
+                    "filled": int(filled),
+                    "n_processes": int(n_processes),
+                },
+            )
+
     def add_ckpt_write(self, seconds: float, nbytes: int) -> None:
         with self._lock:
             self.ckpt_write_s += float(seconds)
@@ -297,6 +329,11 @@ class ChunkPipelineStats:
             "ckpt_write_s": round(self.ckpt_write_s, 4),
             "ckpt_bytes": self.ckpt_bytes,
             "ckpt_boundary_bytes": list(self.ckpt_boundary_bytes),
+            # ISSUE 13 distributed-checkpoint commit telemetry
+            # (0/0.0 on single-host runs — they publish no
+            # generations)
+            "ckpt_generations": self.ckpt_generations,
+            "ckpt_commit_s": round(self.ckpt_commit_s, 4),
             # fraction of the wall during which the device had work
             # queued — the whole-chip efficiency headline
             "overlap_efficiency": (

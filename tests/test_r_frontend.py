@@ -374,6 +374,36 @@ class TestResilienceKnobs:
             in r_src
         )
 
+    def test_ckpt_commit_timeout_wired(self):
+        """The ISSUE 13 front-end addition: R
+        ``ckpt.commit.timeout.s`` must exist with the SMKConfig
+        default and feed ``ckpt_commit_timeout_s`` (the distributed
+        checkpoint's per-commit deadline) — source-checked like its
+        ISSUE 11 siblings, plus the config-side validation."""
+        import os
+
+        from smk_tpu.config import SMKConfig
+
+        r_src = open(
+            os.path.join(
+                os.path.dirname(os.path.dirname(__file__)),
+                "r", "meta_kriging_tpu.R",
+            )
+        ).read()
+        assert "ckpt.commit.timeout.s = 120" in r_src
+        assert (
+            "ckpt_commit_timeout_s = ckpt.commit.timeout.s" in r_src
+        )
+        # R doubles arrive as floats; the field validates like its
+        # dist_init sibling
+        assert SMKConfig(
+            ckpt_commit_timeout_s=30.0
+        ).ckpt_commit_timeout_s == 30.0
+        with pytest.raises(
+            ValueError, match="ckpt_commit_timeout_s"
+        ):
+            SMKConfig(ckpt_commit_timeout_s=0.0)
+
     def test_config_accepts_r_double_spellings(self):
         """reticulate ships R numerics as Python floats: the new
         int-like knob must coerce (dist_init_retries) and the float

@@ -979,9 +979,144 @@ class TestTreeGate:
         )
 
 
+class TestAtomicWrite:
+    """SMK113 (ISSUE 13): durable-state modules (checkpoint, compile
+    store, reporter) may not open a path for truncating write outside
+    the write-to-temp + atomic-rename shape — a crash mid-write
+    strands a torn file that resume/store code later re-reads."""
+
+    DURABLE = "smk_tpu/utils/checkpoint.py"
+
+    def test_direct_truncating_write_flagged(self):
+        for mode in ("'w'", "'wb'"):
+            src = (
+                "def dump(path, data):\n"
+                f"    with open(path, {mode}) as f:\n"
+                "        f.write(data)\n"
+            )
+            assert "SMK113" in rules_hit(src, path=self.DURABLE), mode
+
+    def test_mode_keyword_and_alias_spellings_flagged(self):
+        cases = [
+            # mode= keyword
+            "def dump(p, d):\n"
+            "    with open(p, mode='wb') as f:\n"
+            "        f.write(d)\n",
+            # io.open attribute spelling
+            "import io\n"
+            "def dump(p, d):\n"
+            "    with io.open(p, 'w') as f:\n"
+            "        f.write(d)\n",
+            # from-import alias of open
+            "from io import open as op\n"
+            "def dump(p, d):\n"
+            "    with op(p, 'wb') as f:\n"
+            "        f.write(d)\n",
+            # pathlib method spelling
+            "from pathlib import Path\n"
+            "def dump(p, d):\n"
+            "    with Path(p).open('w') as f:\n"
+            "        f.write(d)\n",
+            # pathlib direct writes
+            "from pathlib import Path\n"
+            "def dump(p, d):\n"
+            "    Path(p).write_bytes(d)\n",
+        ]
+        for src in cases:
+            assert "SMK113" in rules_hit(src, path=self.DURABLE), src
+
+    def test_atomic_rename_shape_passes(self):
+        src = (
+            "import os\n"
+            "def dump(path, data):\n"
+            "    tmp = path + '.tmp'\n"
+            "    with open(tmp, 'wb') as f:\n"
+            "        f.write(data)\n"
+            "    os.replace(tmp, path)\n"
+        )
+        assert "SMK113" not in rules_hit(src, path=self.DURABLE)
+
+    def test_read_and_append_modes_pass(self):
+        src = (
+            "def load(path):\n"
+            "    with open(path, 'rb') as f:\n"
+            "        return f.read()\n"
+            "def log(path, line):\n"
+            "    with open(path, 'a') as f:\n"
+            "        f.write(line)\n"
+        )
+        assert "SMK113" not in rules_hit(src, path=self.DURABLE)
+
+    def test_nonconstant_mode_flagged(self):
+        src = (
+            "def dump(path, data, append):\n"
+            "    with open(path, 'a' if append else 'w') as f:\n"
+            "        f.write(data)\n"
+        )
+        assert "SMK113" in rules_hit(src, path=self.DURABLE)
+
+    def test_scope_durable_modules_only(self):
+        src = (
+            "def dump(path, data):\n"
+            "    with open(path, 'w') as f:\n"
+            "        f.write(data)\n"
+        )
+        # non-durable library code, tests and scripts are out of
+        # scope — the discipline protects re-read durable state, not
+        # every file write in the repo
+        assert "SMK113" not in rules_hit(src, path=MODELS_PATH)
+        assert "SMK113" not in rules_hit(src, path=TESTS_PATH)
+        assert "SMK113" not in rules_hit(src, path=SCRIPT_PATH)
+        for durable in (
+            "smk_tpu/parallel/checkpoint.py",
+            "smk_tpu/compile/store.py",
+            "smk_tpu/obs/reporter.py",
+        ):
+            assert "SMK113" in rules_hit(src, path=durable), durable
+
+    def test_suppression_honored(self):
+        src = (
+            "def dump(path, data):\n"
+            "    # smklint: disable=SMK113 -- append-atomic by contract\n"
+            "    with open(path, 'w') as f:\n"
+            "        f.write(data)\n"
+        )
+        assert "SMK113" not in rules_hit(src, path=self.DURABLE)
+
+    def test_real_checkpoint_clean_and_seeded_defect_caught(self):
+        real = repo_file("smk_tpu/utils/checkpoint.py")
+        assert "SMK113" not in rules_hit(
+            real, path="smk_tpu/utils/checkpoint.py"
+        )
+        seeded = real + (
+            "\n\ndef _fast_save(path, blob):\n"
+            "    with open(path, 'wb') as f:\n"
+            "        f.write(blob)\n"
+        )
+        assert "SMK113" in rules_hit(
+            seeded, path="smk_tpu/utils/checkpoint.py"
+        )
+
+    def test_real_durable_modules_lint_clean(self):
+        # the whole durable set, incl. the reporter's one justified
+        # suppression, is clean with SMK113 active
+        for rel in (
+            "smk_tpu/parallel/checkpoint.py",
+            "smk_tpu/parallel/recovery.py",
+            "smk_tpu/compile/store.py",
+            "smk_tpu/compile/xla_cache.py",
+            "smk_tpu/obs/reporter.py",
+            "smk_tpu/obs/events.py",
+        ):
+            assert "SMK113" not in rules_hit(
+                repo_file(rel), path=rel
+            ), rel
+
+
 @pytest.mark.parametrize("rule_id", [
     "SMK101", "SMK102", "SMK103", "SMK104", "SMK105", "SMK106",
     "SMK107", "SMK108", "SMK109", "SMK110", "SMK111", "SMK112",
+    "SMK113",
 ])
 def test_every_rule_documented_in_catalogue(rule_id):
     from smk_tpu.analysis.lint import _list_rules
