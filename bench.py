@@ -102,6 +102,19 @@ typed-NotImplementedError skip — carries the generation count and
 commit seconds; every chunked rung stamps ckpt_generations /
 ckpt_commit_s top-level either way.
 
+BENCH_SERVE=1 appends the ISSUE 14 kriging-as-a-service rung: a
+small fit is frozen into a serving artifact (smk_tpu/serve/) and the
+batched prediction engine is measured — cold (first request pays
+compile) vs AOT-warm (bucket ladder precompiled through the L2
+store, zero request-time compile) first-request latency, then
+p50/p99 latency and completed-QPS at 1/8/64-way caller concurrency —
+with program_sources / requests_shed / rows_degraded stamped
+top-level. BENCH_SERVE_N / BENCH_SERVE_K / BENCH_SERVE_ITERS /
+BENCH_SERVE_BATCH / BENCH_SERVE_REQUESTS resize it
+(scripts/serve_probe.py is the chaos-protocol sibling:
+stall→typed-timeout, flood→shed, NaN→bitwise-partial,
+fresh-process-zero-compile → SERVE_r15.jsonl).
+
 Synthetic latent surfaces use random Fourier features (an O(n)
 stationary GP approximation) so data generation never needs an n x n
 factorization.
@@ -1024,6 +1037,156 @@ def run_rung_mesh_e2e(name, *, n, k, n_samples, cov_model="exponential",
         if v is not None and not math.isfinite(v):
             record["pipeline"][live_key] = None
     return record
+
+
+def run_rung_serve_latency(name, *, solver_env=None, n=None, k=None,
+                           n_samples=None, n_test=64):
+    """BENCH_SERVE=1 (ISSUE 14): the kriging-as-a-service rung.
+
+    Fits a small model, freezes it into a serving artifact
+    (smk_tpu/serve/), then measures the batched prediction engine:
+    cold (no AOT warm — the first request pays compile) vs AOT-warm
+    first-request latency, and p50/p99 latency + completed-QPS at
+    1/8/64-way caller concurrency on the warm engine. Stamps
+    ``program_sources`` / ``requests_shed`` / ``rows_degraded``
+    top-level — the serving axis's own telemetry contract.
+    BENCH_SERVE_N / BENCH_SERVE_K / BENCH_SERVE_BATCH /
+    BENCH_SERVE_REQUESTS resize it.
+    """
+    import tempfile
+    import threading
+
+    from smk_tpu.api import fit_meta_kriging
+    from smk_tpu.serve import PredictionEngine, save_artifact
+    from smk_tpu.utils.tracing import ChunkPipelineStats
+
+    env = solver_env or {}
+    n = n or int(os.environ.get("BENCH_SERVE_N", 1024))
+    k = k or int(os.environ.get("BENCH_SERVE_K", 8))
+    n_samples = n_samples or int(
+        os.environ.get("BENCH_SERVE_ITERS", 100)
+    )
+    batch = int(os.environ.get("BENCH_SERVE_BATCH", 32))
+    n_req = int(os.environ.get("BENCH_SERVE_REQUESTS", 64))
+    cfg = rung_config(
+        env, k=k, n_samples=n_samples, cov_model="exponential",
+        link="probit",
+    )
+    key = jax.random.key(0)
+    y, x, coords = make_binary_field(key, n + n_test, q=1, p=2)
+    y, x, coords, coords_test, x_test = (
+        y[:n], x[:n], coords[:n], coords[n:], x[n:],
+    )
+    t0 = time.time()
+    res = fit_meta_kriging(
+        jax.random.key(2), y, x, coords, coords_test, x_test,
+        config=cfg,
+    )
+    fit_s = time.time() - t0
+    tmp = tempfile.mkdtemp(prefix="smk_serve_bench_")
+    artifact_path = os.path.join(tmp, "fit.artifact.npz")
+    save_artifact(artifact_path, res, coords_test, config=cfg)
+    store = os.path.join(tmp, "store")
+    buckets = (8, 32, max(32, batch))
+    rng = np.random.default_rng(5)
+    req_c = rng.uniform(size=(n_req, batch, 2)).astype(np.float32)
+    req_x = rng.normal(size=(n_req, batch, 1, 2)).astype(np.float32)
+
+    # cold: no AOT warm, no store — the first request pays compile
+    # in-dispatch (the tax the warm path exists to kill)
+    cold_stats = ChunkPipelineStats()
+    cold = PredictionEngine(
+        artifact_path, buckets=buckets, warm=False,
+        pipeline_stats=cold_stats, default_deadline_s=600.0,
+    )
+    t0 = time.time()
+    cold.predict(req_c[0], req_x[0], seed=0)
+    cold_first_s = time.time() - t0
+
+    # AOT-warm: a second engine warms through the L2 store at
+    # construction, so its first request is pure execution
+    pstats = ChunkPipelineStats()
+    t0 = time.time()
+    engine = PredictionEngine(
+        artifact_path, buckets=buckets, max_queue=256,
+        max_in_flight=4, compile_store_dir=store,
+        pipeline_stats=pstats, default_deadline_s=600.0,
+    )
+    warm_build_s = time.time() - t0
+    t0 = time.time()
+    warm_first = engine.predict(req_c[0], req_x[0], seed=0)
+    warm_first_s = time.time() - t0
+
+    def measure(conc):
+        lat, errs = [], []
+        lock = threading.Lock()
+        idx = iter(range(n_req))
+
+        def worker():
+            while True:
+                with lock:
+                    i = next(idx, None)
+                if i is None:
+                    return
+                try:
+                    r = engine.predict(req_c[i], req_x[i], seed=i)
+                    with lock:
+                        lat.append(r.latency_s)
+                except Exception as e:  # noqa: BLE001 - recorded
+                    with lock:
+                        errs.append(repr(e))
+
+        threads = [
+            threading.Thread(target=worker) for _ in range(conc)
+        ]
+        t0 = time.time()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=600.0)
+        wall = time.time() - t0
+        if not lat:
+            # every request failed: report the WHY instead of
+            # crashing the rung on an empty percentile
+            return {
+                "completed": 0,
+                "errors": len(errs),
+                "error_sample": errs[:3],
+            }
+        lat_ms = np.asarray(sorted(lat)) * 1e3
+        return {
+            "completed": len(lat),
+            "errors": len(errs),
+            "p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+            "p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
+            "qps": round(len(lat) / wall, 1),
+        }
+
+    concurrency = {
+        str(c): measure(c) for c in (1, 8, 64)
+    }
+    health = engine.health()
+    return {
+        "rung": name,
+        "n": n, "K": k, "m": n // k, "iters": n_samples,
+        "fit_s": round(fit_s, 1),
+        "n_draws": int(np.asarray(res.sample_par).shape[0]),
+        "n_anchor": int(coords_test.shape[0]),
+        "batch_rows": batch, "n_requests": n_req,
+        "buckets": list(engine.buckets),
+        "cold_first_request_s": round(cold_first_s, 3),
+        "warm_build_s": round(warm_build_s, 3),
+        "warm_first_request_s": round(warm_first_s, 4),
+        "concurrency": concurrency,
+        "finite": bool(np.isfinite(warm_first.p_quant).all()),
+        "requests_shed": health["requests_shed"],
+        "requests_timed_out": health["requests_timed_out"],
+        "rows_degraded": health["rows_degraded"],
+        "health_state": health["state"],
+        "program_sources": pstats.program_summary()[
+            "program_sources"
+        ],
+    }
 
 
 def run_rung(name, *, n, k, cov_model, n_samples, q=1, p=2, n_test=64,
@@ -2091,6 +2254,23 @@ def main():
         except Exception as e:
             reporter.ladder.append(
                 {"rung": "mesh_e2e", "error": repr(e)}
+            )
+            reporter.emit(partial=True)
+
+    # Serving rung (ISSUE 14): BENCH_SERVE=1 appends the
+    # kriging-as-a-service latency/QPS rung — cold vs AOT-warm
+    # first-request latency plus p50/p99/QPS at 1/8/64-way
+    # concurrency over a frozen fit artifact (scripts/serve_probe.py
+    # is the chaos-protocol sibling emitting SERVE_r15.jsonl).
+    # Reporter-first fallible like every probe cell.
+    if os.environ.get("BENCH_SERVE", "0") == "1":
+        try:
+            reporter.add_rung(run_rung_serve_latency(
+                "serve_latency", solver_env=env,
+            ))
+        except Exception as e:
+            reporter.ladder.append(
+                {"rung": "serve_latency", "error": repr(e)}
             )
             reporter.emit(partial=True)
 

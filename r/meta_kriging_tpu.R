@@ -317,3 +317,87 @@ plot_smk_traces <- function(fit) {
   plot(fit$Samplew[, 1], type = "l",
        main = "combined posterior: latent 1", ylab = "w*[1]")
 }
+
+# Serving pass-through (ISSUE 14, smk_tpu/serve/): predict p(y=1)
+# with credible intervals at arbitrary query locations from a frozen
+# fit artifact (smk_tpu.serve.save_artifact), through the batched
+# prediction engine — AOT-warm bucket ladder, bounded admission,
+# per-request deadlines, per-row NaN quarantine.
+#
+# artifact.path: path of the .npz bundle save_artifact wrote.
+# coords.query: n_q x d matrix; x.query: list of q n_q x p design
+#   matrices (same layout convention as x.test above).
+# deadline.ms: per-request deadline budget in milliseconds (NULL =
+#   the engine default). A wedged dispatch raises the typed Python
+#   RequestTimeoutError within the deadline instead of hanging R.
+# compile.store.dir: optional ISSUE 8 L2 store — a warm store serves
+#   with zero XLA compiles.
+# one engine per (artifact, store) per R session: the engine's whole
+# design is that warm-up (artifact load + device_put + AOT compile
+# of the bucket ladder) happens ONCE and requests are pure execution
+# — rebuilding it per call would re-pay compile on every predict
+.smk.serve.engines <- new.env(parent = emptyenv())
+
+smk.predict.serve <- function(artifact.path, coords.query, x.query,
+                              deadline.ms = NULL,
+                              seed = 0,
+                              compile.store.dir = NULL) {
+  # the file's identity (mtime + size) rides the cache key: a
+  # re-saved artifact at the same path must build a FRESH engine,
+  # never silently serve the stale fit
+  art_info <- file.info(artifact.path)
+  eng_key <- paste0(
+    artifact.path, "|",
+    as.numeric(art_info$mtime), "|", art_info$size, "|",
+    if (is.null(compile.store.dir)) "" else compile.store.dir
+  )
+  eng <- get0(eng_key, envir = .smk.serve.engines)
+  if (is.null(eng)) {
+    serve <- reticulate::import("smk_tpu.serve")
+    eng_args <- list(artifact.path)
+    if (!is.null(compile.store.dir)) {
+      eng_args$compile_store_dir <- compile.store.dir
+    }
+    eng <- do.call(serve$PredictionEngine, eng_args)
+    # evict engines superseded by a re-save of the same artifact at
+    # this (path, store) — their key differs only in mtime/size, and
+    # without eviction a long-lived session (e.g. a Shiny server that
+    # periodically re-exports the fit) pins one full engine — device
+    # arrays + compiled bucket ladder — per re-export, forever
+    store_sfx <- paste0(
+      "|", if (is.null(compile.store.dir)) "" else compile.store.dir
+    )
+    stale <- Filter(
+      function(k) {
+        k != eng_key &&
+          startsWith(k, paste0(artifact.path, "|")) &&
+          endsWith(k, store_sfx)
+      },
+      ls(envir = .smk.serve.engines)
+    )
+    if (length(stale)) rm(list = stale, envir = .smk.serve.engines)
+    assign(eng_key, eng, envir = .smk.serve.engines)
+  }
+  if (is.matrix(x.query)) x.query <- list(x.query)
+  xq_arr <- aperm(simplify2array(x.query), c(1, 3, 2))
+  args <- list(
+    reticulate::np_array(coords.query, dtype = "float32"),
+    reticulate::np_array(xq_arr, dtype = "float32"),
+    seed = as.integer(seed)
+  )
+  if (!is.null(deadline.ms)) {
+    args$deadline_s <- deadline.ms / 1000
+  }
+  res <- do.call(eng$predict, args)
+  to_r <- function(a) reticulate::py_to_r(reticulate::import("numpy")$asarray(a))
+  list(
+    p.quant = to_r(res$p_quant),
+    # per-row quarantine mask of the typed PARTIAL response: TRUE
+    # rows came back non-finite and must not be used
+    rows.degraded = as.logical(to_r(res$rows_degraded)),
+    buckets = as.integer(unlist(res$buckets)),
+    request.id = res$request_id,
+    latency.s = res$latency_s,
+    health = eng$health()
+  )
+}

@@ -25,8 +25,13 @@ MCMC, batched m×m Choleskys on the MXU, collectives over the mesh.
 from smk_tpu.config import SMKConfig, PriorConfig
 from smk_tpu.api import (
     MetaKrigingResult,
+    PredictAtResult,
+    QueryValidationError,
     fit_meta_kriging,
+    predict_at,
     predict_probability,
+    prediction_factors,
+    validate_query_batch,
 )
 from smk_tpu.parallel.partition import random_partition, Partition
 from smk_tpu.parallel.combine import (
@@ -61,8 +66,13 @@ __all__ = [
     "SMKConfig",
     "PriorConfig",
     "MetaKrigingResult",
+    "PredictAtResult",
+    "QueryValidationError",
     "fit_meta_kriging",
+    "predict_at",
     "predict_probability",
+    "prediction_factors",
+    "validate_query_batch",
     "random_partition",
     "Partition",
     "SubsetSurvivalError",

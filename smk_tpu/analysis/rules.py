@@ -1461,6 +1461,9 @@ _DURABLE_MODULES = (
     "smk_tpu/compile/xla_cache",
     "smk_tpu/obs/reporter",
     "smk_tpu/obs/events",
+    # serving artifacts (ISSUE 14): a torn fit bundle is a torn
+    # deployment — same write-to-temp + atomic-rename contract
+    "smk_tpu/serve/artifact",
 )
 
 
@@ -1627,6 +1630,115 @@ class AtomicWriteRule(Rule):
             )
 
 
+# ---------------------------------------------------------------------------
+# SMK114 — deadline discipline on the serving request path
+# ---------------------------------------------------------------------------
+
+# the spellings serve code can reach (or synchronously wait on) the
+# device by: the engine's ONE dispatch seam, plus the raw jax syncs
+_SERVE_DISPATCH_NAMES = {"_invoke_program", "invoke_program"}
+_SERVE_SYNC_ATTRS = {"block_until_ready", "device_get"}
+
+
+class DeadlineDisciplineRule(Rule):
+    id = "SMK114"
+    name = "deadline-discipline"
+    doc = (
+        "request-path code in smk_tpu/serve/ reaching a jit dispatch "
+        "(the engine's _invoke_program seam) or a device sync "
+        "(block_until_ready/device_get) outside a watchdog/deadline "
+        "context — every serve dispatch must run inside a function "
+        "handed to serve.deadline.run_under_deadline (or a "
+        "watchdog's .run), because a bare dispatch on the caller "
+        "thread reintroduces exactly the unbounded hang the "
+        "request-deadline contract (ISSUE 14) exists to exclude: a "
+        "wedged device program must become a typed "
+        "RequestTimeoutError within the deadline, never a hung "
+        "caller"
+    )
+
+    def applies(self, module):
+        return "smk_tpu/serve/" in module.norm_path()
+
+    @staticmethod
+    def _guarded(tree):
+        """(names, lambda-nodes) the module hands to a deadline
+        runner: the first argument of ``run_under_deadline(fn, ...)``
+        or of any ``<watchdog|deadline>.run(fn, ...)`` — a local
+        ``def worker(): ...`` passed by name, or an inline lambda.
+        Name-level matching (not scope-chased) — the same pragmatic
+        looseness as SMK111/112's alias handling."""
+        names: Set[str] = set()
+        lambdas: list = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            chain = attr_chain(node.func)
+            runner = chain[-1:] == ("run_under_deadline",) or (
+                chain[-1:] == ("run",)
+                and any(
+                    "deadline" in part.lower()
+                    or "watchdog" in part.lower()
+                    for part in chain[:-1]
+                )
+            )
+            if not runner:
+                continue
+            arg0 = node.args[0]
+            if isinstance(arg0, ast.Name):
+                names.add(arg0.id)
+            elif isinstance(arg0, ast.Lambda):
+                lambdas.append(arg0)
+        return names, lambdas
+
+    def check(self, module, ctx):
+        names, lambdas = self._guarded(module.tree)
+        funcs = [
+            n for n in ast.walk(module.tree)
+            if isinstance(
+                n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            )
+        ]
+
+        def is_guarded(node) -> bool:
+            for fn in funcs:
+                if not any(sub is node for sub in ast.walk(fn)):
+                    continue
+                if isinstance(
+                    fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and fn.name in names:
+                    return True
+                if isinstance(fn, ast.Lambda) and any(
+                    fn is lam for lam in lambdas
+                ):
+                    return True
+            return False
+
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            dispatch = bool(chain) and (
+                chain[-1] in _SERVE_DISPATCH_NAMES
+                or chain[-1] in _SERVE_SYNC_ATTRS
+            )
+            if not dispatch:
+                continue
+            if is_guarded(node):
+                continue
+            yield self.finding(
+                module, node,
+                f"serve request-path dispatch {'.'.join(chain)}(...) "
+                "outside a deadline context — run it inside a "
+                "function handed to "
+                "serve.deadline.run_under_deadline(fn, budget, ...) "
+                "so a wedged device program becomes a typed "
+                "RequestTimeoutError within the request deadline "
+                "instead of hanging the caller (ISSUE 14 "
+                "deadline-discipline)",
+            )
+
+
 ALL_RULES = [
     BatchingRuleRule(),
     HostNondeterminismRule(),
@@ -1641,4 +1753,5 @@ ALL_RULES = [
     UnboundedWaitRule(),
     MeshHygieneRule(),
     AtomicWriteRule(),
+    DeadlineDisciplineRule(),
 ]

@@ -21,7 +21,11 @@ import jax.numpy as jnp
 
 from smk_tpu.config import SMKConfig
 from smk_tpu.models.probit_gp import SpatialGPSampler, SubsetResult
+from smk_tpu.ops.chol import jittered_cholesky, tri_solve
+from smk_tpu.ops.distance import cross_distance, pairwise_distance
+from smk_tpu.ops.factor_cache import FactorCache, empty_counter, tick
 from smk_tpu.ops.glm import glm_warm_start
+from smk_tpu.ops.kernels import correlation
 from smk_tpu.ops.quantiles import (
     credible_summary,
     interp_quantile_grid,
@@ -154,6 +158,258 @@ def predict_probability(
     eta_fixed = jnp.einsum("tqp,sqp->stq", x_test, betas)  # (S, t, q)
     eta = eta_fixed.reshape(sample_par.shape[0], -1) + sample_w
     return _link_prob(eta, link)
+
+
+class QueryValidationError(ValueError):
+    """A prediction query batch failed validation at the serve/API
+    boundary (ISSUE 14): NaN/Inf coordinates, a wrong coordinate or
+    design dimension, or an empty batch. Raised BEFORE any dispatch —
+    a non-finite query must never silently propagate into the
+    composition draw and come back as a NaN probability row."""
+
+
+def validate_query_batch(coords_query, x_query, *, d: int, q: int, p: int):
+    """Validate one prediction query batch against the fit's geometry.
+
+    ``coords_query``: (u, d) locations; ``x_query``: (u, q, p)
+    designs. Returns them as contiguous float numpy arrays (the
+    serving engine pads from the host side). Raises
+    :class:`QueryValidationError` — typed, actionable, and before any
+    device work — on an empty batch, wrong shapes, or non-finite
+    values; the historical fit-entry checks only covered shapes, so a
+    NaN query used to sail through to the sampler.
+    """
+    import numpy as np
+
+    try:
+        cq = np.asarray(coords_query, np.float32)
+    except (TypeError, ValueError) as e:
+        raise QueryValidationError(
+            f"coords_query is not a numeric array ({e!r})"
+        ) from e
+    if cq.ndim != 2 or cq.shape[1] != d:
+        raise QueryValidationError(
+            f"coords_query must be (n_queries, d={d}) locations, got "
+            f"shape {cq.shape}"
+        )
+    if cq.shape[0] == 0:
+        raise QueryValidationError(
+            "empty query batch — coords_query has zero rows"
+        )
+    if not np.isfinite(cq).all():
+        bad = np.unique(np.argwhere(~np.isfinite(cq))[:, 0])[:8]
+        raise QueryValidationError(
+            "coords_query contains non-finite values at rows "
+            f"{bad.tolist()} — a NaN/Inf coordinate would propagate "
+            "into the composition draw as a silent NaN probability"
+        )
+    try:
+        xq = np.asarray(x_query, np.float32)
+    except (TypeError, ValueError) as e:
+        raise QueryValidationError(
+            f"x_query is not a numeric array ({e!r})"
+        ) from e
+    if xq.shape != (cq.shape[0], q, p):
+        raise QueryValidationError(
+            f"x_query must be (n_queries={cq.shape[0]}, q={q}, "
+            f"p={p}) designs, got shape {xq.shape}"
+        )
+    if not np.isfinite(xq).all():
+        bad = np.unique(np.argwhere(~np.isfinite(xq))[:, 0])[:8]
+        raise QueryValidationError(
+            "x_query contains non-finite values at rows "
+            f"{bad.tolist()}"
+        )
+    return np.ascontiguousarray(cq), np.ascontiguousarray(xq)
+
+
+def _krige_predict_core(
+    chol_tt, w_test, betas, phi, coords_test, coords_q, x_q, eps,
+    *, cov_model: str, link: str, var_floor: float,
+):
+    """The pure kriging composition at query locations — the ONE
+    formula both the eager :func:`predict_at` path and the serving
+    engine's compiled bucket programs (smk_tpu/serve/engine.py) run,
+    so engine responses are bit-identical to the library path at
+    equal shapes.
+
+    Per component j: W = R_tt^{-1} R_cross via the cached anchor
+    factor, the conditional mean carries each combined-posterior
+    latent draw to the queries, and the draw uses the MARGINAL
+    conditional variance (each query's own predictive band — the
+    serving contract), which keeps every query row arithmetically
+    independent of every other row: pad rows cannot perturb real
+    rows (the bucket-ladder identity) and a non-finite row quarantines
+    alone (the PR 7 share-nothing invariant applied to serving).
+
+    chol_tt: (q, t, t) anchor-grid Cholesky; w_test: (S, t, q)
+    combined latent draws at the anchor grid; betas: (S, q, p);
+    phi: (q,) plug-in decay; coords_q: (u, d); x_q: (u, q, p);
+    eps: (S, u, q) standard-normal draws. Returns p(y=1) (S, u, q).
+    """
+    rc = correlation(
+        cross_distance(coords_test, coords_q)[None],
+        phi[:, None, None], cov_model,
+    )  # (q, t, u)
+    v = jax.vmap(lambda l, r: tri_solve(l, r))(chol_tt, rc)
+    wmat = jax.vmap(lambda l, r: tri_solve(l, r, trans=True))(
+        chol_tt, v
+    )  # (q, t, u) = R_tt^{-1} R_cross
+    mean = jnp.einsum("stq,qtu->suq", w_test, wmat)  # (S, u, q)
+    var = jnp.maximum(
+        1.0 - jnp.einsum("qtu,qtu->qu", rc, wmat),
+        jnp.asarray(var_floor, rc.dtype),
+    )  # (q, u) marginal conditional variance
+    w_q = mean + jnp.sqrt(var).T[None, :, :] * eps
+    eta = jnp.einsum("uqp,sqp->suq", x_q, betas) + w_q
+    return _link_prob(eta, link)
+
+
+def prediction_factors(
+    coords_test: jnp.ndarray,
+    phi: jnp.ndarray,
+    *,
+    config: Optional[SMKConfig] = None,
+) -> FactorCache:
+    """Build the query-independent kriging operators of the serving
+    predict path ONCE, as a :class:`~smk_tpu.ops.factor_cache.
+    FactorCache` (the same reuse engine the Gibbs hot loop threads):
+    ``krige_chol`` holds the (q, t, t) Cholesky of the anchor-grid
+    correlation R_tt(phi) + jitter — the only m-sized factorization a
+    predict needs — and ``n_chol`` ticks q, so a caller (or the
+    regression test) can pin that a cache-threaded second predict
+    performs ZERO factor rebuilds. Every other field stays None (the
+    serve path has no CG/trisolve state)."""
+    cfg = config or SMKConfig()
+    t = coords_test.shape[0]
+    r_tt = correlation(
+        pairwise_distance(coords_test)[None],
+        jnp.asarray(phi)[:, None, None], cfg.cov_model,
+    )  # (q, t, t)
+    chol_tt = jittered_cholesky(r_tt, cfg.effective_jitter(t))
+    cache = FactorCache(
+        r_mv=None, nys_z=None, chol_inv=None,
+        krige_w=None, krige_chol=chol_tt,
+        n_chol=empty_counter(), n_chol_calls=empty_counter(),
+    )
+    return tick(cache, int(phi.shape[0]), 1)
+
+
+def _median_row(n_rows: int) -> int:
+    """Row index of the 0.5 quantile in a combined quantile grid: row
+    i holds probability (i+1)/n (ops/quantiles.quantile_probs), so the
+    exact median of an even-length grid sits at n//2 - 1 — n//2 is
+    half a grid step high (the 50.5% row at the default
+    n_quantiles=200); odd grids have no exact row and take the upper
+    neighbor."""
+    return (n_rows + 1) // 2 - 1
+
+
+def plugin_phi_layout(result: MetaKrigingResult, t: int) -> tuple:
+    """(q, p, phi) of a fit at anchor size ``t`` — the ONE site that
+    inverts the ``sample_par`` packing (q·p betas + q(q+1)/2 K entries
+    + q phis, matching the param_names inventory) and selects the
+    plug-in posterior-median phi from the combined quantile grid.
+    Shared by :func:`predict_at` and ``serve.artifact.save_artifact``
+    so the library path and frozen artifacts can never disagree on the
+    serving geometry. ``phi`` returns as a (q,) numpy array."""
+    import numpy as np
+
+    n_w = int(np.asarray(result.sample_w).shape[1])
+    n_par = int(np.asarray(result.sample_par).shape[1])
+    q = n_w // t
+    p = (n_par - q * (q + 1) // 2 - q) // q if q > 0 else -1
+    # the inversion is only valid when t is the fit's true anchor
+    # size: a mismatched coords_test still floor-divides into SOME
+    # (q, p) whose reshape can succeed on sheer element count, and
+    # the wrong beta/phi slices would flow silently into the kriging
+    # (or freeze into a served artifact) — reject typed instead
+    if (
+        q <= 0 or p <= 0 or n_w != q * t
+        or n_par != q * p + q * (q + 1) // 2 + q
+    ):
+        raise QueryValidationError(
+            f"anchor grid of {t} rows is inconsistent with this fit: "
+            f"sample_w has {n_w} latents and sample_par {n_par} "
+            "parameters, which do not factor as (q responses x "
+            f"{t} anchors) + (q*p + q(q+1)/2 + q) — pass the SAME "
+            "coords_test the fit was run with"
+        )
+    grid = np.asarray(result.param_grid)
+    phi = np.asarray(grid[_median_row(grid.shape[0]), -q:])
+    return q, p, phi
+
+
+class PredictAtResult(NamedTuple):
+    """One query-location predict: ``p_samples`` (S, u, q) posterior
+    p(y=1) draws and ``p_quant`` (3, u, q) [median, 2.5%, 97.5%] per
+    query row — the reference's predictive summary (R:163-165) at
+    locations the fit never saw."""
+
+    p_samples: jnp.ndarray
+    p_quant: jnp.ndarray
+
+
+def predict_at(
+    result: MetaKrigingResult,
+    coords_test: jnp.ndarray,
+    coords_query,
+    x_query,
+    *,
+    key: Optional[jax.Array] = None,
+    config: Optional[SMKConfig] = None,
+    cache: Optional[FactorCache] = None,
+) -> tuple:
+    """p(y=1) with credible intervals at ARBITRARY query locations
+    from a frozen fit — the serving hot path (ISSUE 14, ROADMAP
+    item 2).
+
+    The combined posterior exists at the fit's anchor grid
+    (``coords_test``); each resampled draw's latent is kriged to the
+    queries by conditioning on the anchor grid with the plug-in
+    posterior-median phi (the composition-sampling generalization of
+    R:153-165 — per-draw phi would forbid any factor reuse, and the
+    median is the reference's own point summary). The anchor-grid
+    Cholesky is the query-independent factor: pass the returned
+    ``cache`` back in and a repeated predict on the same fit performs
+    ZERO m-sized factorizations (pinned in tests/test_serve.py —
+    before this cache every call re-factored R_tt from scratch).
+
+    Returns ``(PredictAtResult, FactorCache)`` — thread the cache.
+    """
+    cfg = config or SMKConfig()
+    t, d = coords_test.shape
+    q, p, phi_np = plugin_phi_layout(result, t)
+    cq, xq = validate_query_batch(
+        coords_query, x_query, d=d, q=q, p=p
+    )
+    phi = jnp.asarray(phi_np)
+    if cache is None:
+        cache = prediction_factors(
+            jnp.asarray(coords_test), phi, config=cfg
+        )
+    s = result.sample_par.shape[0]
+    if key is None:
+        key = jax.random.key(0)
+    eps = jax.random.normal(
+        key, (s, cq.shape[0], q), result.sample_w.dtype
+    )
+    p_samples = _krige_predict_core(
+        cache.krige_chol,
+        result.sample_w.reshape(s, t, q),
+        result.sample_par[:, : q * p].reshape(s, q, p),
+        phi,
+        jnp.asarray(coords_test),
+        jnp.asarray(cq),
+        jnp.asarray(xq),
+        eps,
+        cov_model=cfg.cov_model, link=cfg.link,
+        var_floor=cfg.effective_jitter(t),
+    )
+    p_quant = credible_summary(
+        p_samples.reshape(s, -1)
+    ).reshape(3, cq.shape[0], q)
+    return PredictAtResult(p_samples, p_quant), cache
 
 
 def fit_meta_kriging(

@@ -1,0 +1,37 @@
+"""Kriging-as-a-service (ISSUE 14, ROADMAP item 2): the batched
+prediction engine over a frozen fit artifact — AOT-warm shape-bucket
+ladder (zero request-time compile), bounded admission with typed
+load-shedding, per-request deadlines, per-row NaN quarantine with
+health states. See serve/engine.py for the full contract."""
+
+from smk_tpu.serve.artifact import (
+    ArtifactError,
+    FitArtifact,
+    load_artifact,
+    save_artifact,
+)
+from smk_tpu.serve.deadline import (
+    DeadlineBudget,
+    RequestTimeoutError,
+    run_under_deadline,
+)
+from smk_tpu.serve.engine import (
+    EngineDrainingError,
+    PredictionEngine,
+    PredictResponse,
+    QueueFullError,
+)
+
+__all__ = [
+    "ArtifactError",
+    "FitArtifact",
+    "load_artifact",
+    "save_artifact",
+    "DeadlineBudget",
+    "RequestTimeoutError",
+    "run_under_deadline",
+    "EngineDrainingError",
+    "PredictionEngine",
+    "PredictResponse",
+    "QueueFullError",
+]

@@ -1,0 +1,241 @@
+"""Fit artifacts: everything the prediction engine needs from a
+completed fit, as ONE integrity-checked bundle (ISSUE 14).
+
+A production predict path must not hold the training data, the MCMC
+state, or a live ``MetaKrigingResult`` — it loads a frozen artifact:
+the combined quantile grids, the resampled composition draws, the
+anchor-grid coordinates, the plug-in phi, and the anchor-grid
+Cholesky factors (built through
+:func:`smk_tpu.api.prediction_factors`, i.e. the
+``ops/factor_cache.FactorCache`` reuse engine — a loaded engine pays
+ZERO m-sized factorizations), plus the fit config's digest for
+provenance.
+
+Integrity follows the checkpoint discipline (utils/checkpoint,
+smklint SMK113): the bundle is one ``.npz`` written via
+write-to-temp + atomic rename, stamped with a CRC32 over every
+payload array AND the format version — a truncated or bit-flipped
+artifact raises a typed :class:`ArtifactError` at load, never a
+silent mis-serve.
+"""
+
+from __future__ import annotations
+
+import os
+import zlib
+from typing import NamedTuple
+
+import numpy as np
+
+from smk_tpu.utils.checkpoint import _atomic_savez
+
+ARTIFACT_VERSION = 1
+
+# EVERY stored field is covered by the CRC, in the exact order
+# hashed — the scalars and strings included, because a flipped byte
+# in jitter/cov_model/link mis-serves every prediction just as
+# silently as one in an array would. Appending a field bumps
+# ARTIFACT_VERSION.
+_PAYLOAD_FIELDS = (
+    "sample_par", "sample_w", "param_grid", "w_grid",
+    "coords_test", "phi", "chol_tt",
+    "q", "p", "jitter", "jitter_per_m",
+    "cov_model", "link", "config_digest", "version",
+)
+
+
+class ArtifactError(RuntimeError):
+    """The artifact at a path cannot be served from: unreadable,
+    truncated, an unknown format version, or a failed integrity
+    checksum. Typed so a serving deployment can distinguish a bad
+    bundle (redeploy it) from an engine fault."""
+
+
+class FitArtifact(NamedTuple):
+    """One frozen fit, ready to serve (see module docstring).
+
+    ``sample_par`` (S, n_params) / ``sample_w`` (S, t*q,
+    response-fastest): the resampled combined-posterior composition
+    draws. ``param_grid`` / ``w_grid``: the combined quantile grids
+    (provenance + the plug-in phi source). ``coords_test`` (t, d):
+    the anchor grid the combined latent posterior lives on.
+    ``phi`` (q,): posterior-median decay (the plug-in kriging
+    geometry). ``chol_tt`` (q, t, t): the anchor-grid Cholesky —
+    the FactorCache-built factor serving reuses on every request.
+    ``cov_model``/``link``/``jitter``/``jitter_per_m``: the config
+    fields the predict composition depends on; ``config_digest``:
+    the fit config's compile-store digest (provenance).
+    """
+
+    sample_par: np.ndarray
+    sample_w: np.ndarray
+    param_grid: np.ndarray
+    w_grid: np.ndarray
+    coords_test: np.ndarray
+    phi: np.ndarray
+    chol_tt: np.ndarray
+    q: int
+    p: int
+    cov_model: str
+    link: str
+    jitter: float
+    jitter_per_m: float
+    config_digest: str
+
+    @property
+    def n_draws(self) -> int:
+        return int(self.sample_par.shape[0])
+
+    @property
+    def n_anchor(self) -> int:
+        return int(self.coords_test.shape[0])
+
+    @property
+    def coord_dim(self) -> int:
+        return int(self.coords_test.shape[1])
+
+    def serve_digest(self) -> str:
+        """Digest of every config-derived field a serve program's
+        lowered module depends on — the bucket-key component that
+        keeps one compile store serving many artifacts of the same
+        geometry while never mis-serving across cov_model/link/jitter
+        changes (shapes ride the key explicitly)."""
+        import hashlib
+
+        return hashlib.sha256(repr((
+            ARTIFACT_VERSION, self.cov_model, self.link,
+            float(self.jitter), float(self.jitter_per_m),
+            str(self.sample_w.dtype),
+        )).encode()).hexdigest()[:12]
+
+    def var_floor(self) -> float:
+        """The marginal-variance floor of the composition draw — the
+        same scale-aware jitter the fit used at the anchor size."""
+        return max(
+            float(self.jitter),
+            float(self.jitter_per_m) * self.n_anchor,
+        )
+
+
+def _crc(arrays: dict) -> int:
+    h = zlib.crc32(np.asarray([ARTIFACT_VERSION], np.int64).tobytes())
+    for name in _PAYLOAD_FIELDS:
+        h = zlib.crc32(np.ascontiguousarray(arrays[name]).tobytes(), h)
+    return h
+
+
+def save_artifact(
+    path: str,
+    result,
+    coords_test,
+    *,
+    config=None,
+    cache=None,
+) -> str:
+    """Persist a fit as a serving artifact.
+
+    ``result`` is the :class:`~smk_tpu.api.MetaKrigingResult`;
+    ``coords_test`` the anchor grid it predicted at; ``cache`` an
+    optional already-built prediction FactorCache (e.g. from
+    :func:`~smk_tpu.api.predict_at`) — when absent the anchor factor
+    is built here once, so the SAVE pays the factorization and every
+    load serves from it. Atomic + CRC-stamped; returns ``path``.
+    """
+    from smk_tpu.api import plugin_phi_layout, prediction_factors
+    from smk_tpu.config import SMKConfig
+
+    cfg = config or SMKConfig()
+    ct = np.asarray(coords_test, np.float32)
+    q, p, phi = plugin_phi_layout(result, ct.shape[0])
+    if cache is None:
+        import jax.numpy as jnp
+
+        cache = prediction_factors(
+            jnp.asarray(ct), jnp.asarray(phi), config=cfg
+        )
+    arrays = {
+        "sample_par": np.asarray(result.sample_par, np.float32),
+        "sample_w": np.asarray(result.sample_w, np.float32),
+        "param_grid": np.asarray(result.param_grid, np.float32),
+        "w_grid": np.asarray(result.w_grid, np.float32),
+        "coords_test": ct,
+        "phi": np.asarray(phi, np.float32),
+        "chol_tt": np.asarray(cache.krige_chol, np.float32),
+        "q": np.asarray([q], np.int64),
+        "p": np.asarray([p], np.int64),
+        "jitter": np.asarray([cfg.jitter], np.float64),
+        "jitter_per_m": np.asarray([cfg.jitter_per_m], np.float64),
+        "cov_model": np.frombuffer(
+            cfg.cov_model.encode(), np.uint8
+        ),
+        "link": np.frombuffer(cfg.link.encode(), np.uint8),
+        "config_digest": np.frombuffer(
+            _fit_digest(cfg).encode(), np.uint8
+        ),
+        "version": np.asarray([ARTIFACT_VERSION], np.int64),
+    }
+    arrays["crc"] = np.asarray([_crc(arrays)], np.uint32)
+    _atomic_savez(path, arrays)
+    return path
+
+
+def _fit_digest(cfg) -> str:
+    from smk_tpu.compile.programs import config_digest
+
+    return config_digest(cfg)
+
+
+def load_artifact(path: str) -> FitArtifact:
+    """Load and verify a serving artifact; raises
+    :class:`ArtifactError` on any integrity failure (missing file,
+    torn npz, unknown version, CRC mismatch) — typed, naming the
+    path, before any engine state is built."""
+    if not os.path.exists(path):
+        raise ArtifactError(f"no serving artifact at {path!r}")
+    try:
+        with np.load(path) as data:
+            arrays = {k: np.asarray(data[k]) for k in data.files}
+    except Exception as e:
+        raise ArtifactError(
+            f"serving artifact {path!r} is unreadable ({e!r}) — "
+            "truncated or corrupt; re-export it with save_artifact"
+        ) from e
+    missing = [
+        k for k in _PAYLOAD_FIELDS + ("crc",)
+        if k not in arrays
+    ]
+    if missing:
+        raise ArtifactError(
+            f"serving artifact {path!r} is missing fields "
+            f"{missing} — not a save_artifact bundle"
+        )
+    version = int(arrays["version"][0])
+    if version != ARTIFACT_VERSION:
+        raise ArtifactError(
+            f"serving artifact {path!r} has format version "
+            f"{version}, this build reads {ARTIFACT_VERSION}"
+        )
+    want = int(arrays["crc"][0])
+    got = _crc(arrays)
+    if got != want:
+        raise ArtifactError(
+            f"serving artifact {path!r} failed its integrity "
+            f"checksum (stored {want:#010x}, recomputed "
+            f"{got:#010x}) — the payload is corrupt"
+        )
+    return FitArtifact(
+        sample_par=arrays["sample_par"],
+        sample_w=arrays["sample_w"],
+        param_grid=arrays["param_grid"],
+        w_grid=arrays["w_grid"],
+        coords_test=arrays["coords_test"],
+        phi=arrays["phi"],
+        chol_tt=arrays["chol_tt"],
+        q=int(arrays["q"][0]),
+        p=int(arrays["p"][0]),
+        cov_model=arrays["cov_model"].tobytes().decode(),
+        link=arrays["link"].tobytes().decode(),
+        jitter=float(arrays["jitter"][0]),
+        jitter_per_m=float(arrays["jitter_per_m"][0]),
+        config_digest=arrays["config_digest"].tobytes().decode(),
+    )

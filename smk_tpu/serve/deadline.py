@@ -1,0 +1,195 @@
+"""Request deadlines for the serving engine (ISSUE 14).
+
+The serve-side adaptation of the chunk watchdog
+(parallel/domains.ChunkWatchdog): a request arrives with a total
+deadline BUDGET, every wait site spends from it (SMK111 — no wait in
+the request path is ever unbounded), and the dispatch itself runs on
+a watchdog worker thread so a wedged device program becomes a typed
+:class:`RequestTimeoutError` naming the in-flight batch within the
+deadline — never a hung caller. The engine keeps serving: the
+abandoned worker is a daemon thread holding no locks, and its late
+result (if any) is discarded.
+
+smklint SMK114 (deadline-discipline) enforces the usage contract:
+request-path code in ``smk_tpu/serve/`` may only reach a jit dispatch
+through a function handed to :func:`run_under_deadline` (or a
+watchdog ``.run``) — a bare dispatch on the caller thread would
+reintroduce exactly the unbounded hang this module exists to exclude.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from smk_tpu.utils.tracing import monotonic
+
+
+class RequestTimeoutError(RuntimeError):
+    """A serving request overran its deadline budget.
+
+    ``label`` names the in-flight batch (request id, bucket, phase),
+    ``phase`` is where the budget ran out (``"queued"`` — the request
+    never reached the device; ``"dispatch"`` — the compiled program
+    overran; ``"guard"`` — the finiteness guard overran), and
+    ``deadline_s`` is the total budget. The engine stays healthy: a
+    timeout sheds THIS request only.
+    """
+
+    def __init__(self, label: str, phase: str, deadline_s: float):
+        self.label = str(label)
+        self.phase = str(phase)
+        self.deadline_s = float(deadline_s)
+        super().__init__(
+            f"request {label!r} overran its {deadline_s:.3f}s "
+            f"deadline in phase {phase!r} — the request is shed; "
+            "the engine keeps serving"
+        )
+
+
+class DeadlineBudget:
+    """One request's monotonic deadline budget.
+
+    Opened at admission with the total seconds; every wait site asks
+    :meth:`remaining` (always >= a small floor so a bounded wait is
+    attempted even at exhaustion, keeping the timeout TYPED rather
+    than racy) and :meth:`expired` gates early sheds. Pure host-side
+    arithmetic — unit-tested in tests/test_serve.py.
+    """
+
+    # the minimum wait ever handed to a lock/thread wait: small
+    # enough to bound the overrun, large enough that an
+    # already-expired budget still produces the typed error path
+    MIN_WAIT_S = 0.001
+
+    def __init__(self, total_s: float):
+        if not (total_s > 0):
+            raise ValueError("deadline budget must be > 0 seconds")
+        self.total_s = float(total_s)
+        self._t0 = monotonic()
+
+    def elapsed(self) -> float:
+        return monotonic() - self._t0
+
+    def remaining(self) -> float:
+        return max(self.MIN_WAIT_S, self.total_s - self.elapsed())
+
+    def expired(self) -> bool:
+        return self.elapsed() >= self.total_s
+
+
+# ---------------------------------------------------------------------------
+# Persistent watchdog workers. A thread create/teardown per call would
+# put two thread spawns (dispatch + guard) on EVERY request slice —
+# measurable churn on the latency path this module serves. Instead
+# idle workers are pooled: run_under_deadline pops one (or starts one
+# when the pool is dry), hands it the job through a single-slot box,
+# and the worker recycles itself after finishing. Abandonment on
+# overrun is implicit and lock-free exactly as before — a wedged
+# worker is simply not in the pool, so the next request never sees
+# it; if its job eventually completes, the late result is discarded
+# via that job's private box and the (healthy again) worker recycles.
+# Idle workers self-reap after _IDLE_REAP_S so a concurrency burst
+# doesn't pin threads forever; _MAX_IDLE bounds the pool.
+
+_IDLE_REAP_S = 60.0
+_MAX_IDLE = 32
+
+_pool_lock = threading.Lock()
+_idle_pool: list = []
+
+
+class _WatchdogWorker:
+    """One persistent daemon worker (single outstanding job).
+
+    Pool discipline guarantees at most one caller holds a worker at a
+    time: a worker is handed out only from the idle pool, and only
+    re-enters the pool after finishing its current job.
+    """
+
+    def __init__(self):
+        self._ready = threading.Event()
+        self._job = None
+        self._thread = threading.Thread(
+            target=self._loop, name="smk-serve-deadline", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, fn, box: dict, done: threading.Event) -> None:
+        self._job = (fn, box, done)
+        self._ready.set()
+
+    def _loop(self):
+        while True:
+            # bounded idle wait (SMK111): after _IDLE_REAP_S with no
+            # work, remove ourselves from the pool and exit — under
+            # the pool lock so a concurrent pop either finds us gone
+            # or has already claimed us (then a job is incoming and
+            # we keep waiting)
+            if not self._ready.wait(timeout=_IDLE_REAP_S):
+                with _pool_lock:
+                    if self in _idle_pool:
+                        _idle_pool.remove(self)
+                        return
+                continue
+            self._ready.clear()
+            fn, box, done = self._job
+            self._job = None
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # re-raised on the caller thread
+                box["exc"] = e
+            finally:
+                done.set()
+                with _pool_lock:
+                    if len(_idle_pool) < _MAX_IDLE:
+                        _idle_pool.append(self)
+                    else:
+                        return
+
+
+def _acquire_worker() -> _WatchdogWorker:
+    with _pool_lock:
+        if _idle_pool:
+            return _idle_pool.pop()
+    return _WatchdogWorker()
+
+
+def run_under_deadline(
+    fn,
+    budget: DeadlineBudget,
+    *,
+    label: str,
+    phase: str = "dispatch",
+    run_log=None,
+):
+    """Execute ``fn()`` on a pooled watchdog worker thread, waiting at
+    most ``budget.remaining()``.
+
+    Returns ``fn``'s result, re-raises its exception, or raises
+    :class:`RequestTimeoutError` on overrun (after emitting a
+    ``deadline`` event into the run log when one is armed). The
+    worker is a daemon: a wedged dispatch is abandoned, never joined
+    unbounded (SMK111), and a late completion is discarded via the
+    job's private result box — the engine's next request dispatches
+    on a different (pooled or fresh) worker.
+    """
+    deadline = budget.remaining()
+    box: dict = {}
+    done = threading.Event()
+
+    worker = _acquire_worker()
+    worker.submit(fn, box, done)
+    if not done.wait(timeout=deadline):
+        if run_log is not None:
+            try:
+                run_log.event(
+                    "deadline", action="fired", label=str(label),
+                    phase=str(phase),
+                    deadline_s=round(budget.total_s, 4),
+                )
+            except Exception:  # pragma: no cover - defensive
+                pass
+        raise RequestTimeoutError(label, phase, budget.total_s)
+    if "exc" in box:
+        raise box["exc"]
+    return box["result"]

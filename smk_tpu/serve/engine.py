@@ -1,0 +1,639 @@
+"""The batched prediction engine: kriging-as-a-service with
+production failure semantics (ISSUE 14, ROADMAP item 2).
+
+One engine wraps one frozen :class:`~smk_tpu.serve.artifact.
+FitArtifact` and serves ``predict(coords_query, x_query)`` —
+p(y=1) with credible intervals at arbitrary query locations — with
+four robustness layers:
+
+- **Zero request-time compile**: incoming queries are micro-batched
+  into a fixed LADDER of query-batch shape buckets (padded with the
+  pad-row identity — the composition draw is row-independent, so pad
+  content can never perturb a real row) and each bucket's program is
+  AOT-compiled at :meth:`~PredictionEngine.warm` through the ISSUE 8
+  L1/L2 program store — a fresh process on a warm store serves its
+  first request with ZERO XLA backend compiles
+  (``recompile_guard(0)``-pinned in SERVE_r15.jsonl).
+- **Admission control**: a bounded waiting room (typed
+  :class:`QueueFullError` IMMEDIATELY when full — never an unbounded
+  wait, SMK111) and a max-in-flight gate so one slow batch cannot
+  convoy the queue.
+- **Deadlines**: every request carries a budget; queue waits spend
+  from it and the dispatch runs under
+  :func:`~smk_tpu.serve.deadline.run_under_deadline` — a wedged
+  program becomes a typed
+  :class:`~smk_tpu.serve.deadline.RequestTimeoutError` naming the
+  in-flight batch, within the deadline, and the engine keeps serving
+  (smklint SMK114 enforces that no serve dispatch escapes this).
+- **Graceful degradation**: a tiny separate guard program (the
+  ``_chunk_stats`` pattern) checks per-row finiteness on device;
+  non-finite rows are quarantined per-row into a typed PARTIAL
+  response (``rows_degraded`` mask, healthy rows bit-identical to an
+  uninjected engine — the PR 7 share-nothing invariant applied to
+  serving), and repeated guard trips flip :meth:`~PredictionEngine.
+  health` to ``"degraded"`` for external probes.
+
+Telemetry rides the PR 9 run log: each request is a ``request`` span
+with nested ``bucket`` → ``dispatch`` → ``guard`` spans (the span
+tree is serialized under the in-flight gate; with ``max_in_flight >
+1`` concurrent requests' spans may interleave parents — latency
+numbers stay exact, the tree is best-effort).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+from smk_tpu.serve.artifact import FitArtifact, load_artifact
+from smk_tpu.serve.deadline import (
+    DeadlineBudget,
+    RequestTimeoutError,
+    run_under_deadline,
+)
+
+DEFAULT_BUCKETS = (8, 32, 128)
+
+# consecutive guard-tripped requests before the engine reports
+# "degraded" (a single cosmic-ray row must not flip a health probe;
+# a streak is a real signal)
+DEFAULT_DEGRADED_THRESHOLD = 3
+
+# generous deadline for the warm-up throwaway dispatch — warm() pays
+# compile by design, but even it must be a bounded wait (SMK111)
+_WARM_DEADLINE_S = 600.0
+
+
+class QueueFullError(RuntimeError):
+    """The engine's bounded waiting room is full — the request is
+    shed IMMEDIATELY (typed, zero wait) so overload degrades into
+    fast rejections, never an unbounded queue or an OOM."""
+
+    def __init__(self, max_queue: int):
+        self.max_queue = int(max_queue)
+        super().__init__(
+            f"serve queue full ({max_queue} waiting) — request shed; "
+            "retry with backoff or raise max_queue"
+        )
+
+
+class EngineDrainingError(RuntimeError):
+    """The engine is draining (shutdown in progress): new requests
+    are rejected typed; in-flight requests complete."""
+
+
+class PredictResponse(NamedTuple):
+    """One served prediction (possibly PARTIAL).
+
+    ``p_quant`` (3, n, q): [median, 2.5%, 97.5%] per query row;
+    ``rows_degraded`` (n,) bool: rows whose prediction came back
+    non-finite and are quarantined (their ``p_quant`` entries are
+    whatever the device produced — consult the mask); healthy rows
+    are bit-identical to a fault-free engine. ``p_samples``
+    (S, n, q) only when the engine was built with
+    ``include_samples=True``. ``buckets``: the ladder buckets each
+    micro-batch slice dispatched through. ``latency_s``: admission
+    to response."""
+
+    p_quant: np.ndarray
+    rows_degraded: np.ndarray
+    p_samples: Optional[np.ndarray]
+    buckets: tuple
+    request_id: str
+    latency_s: float
+
+    @property
+    def degraded(self) -> bool:
+        return bool(self.rows_degraded.any())
+
+
+def _invoke_program(prog, prog_key, *args):
+    """The ONE jit-dispatch seam of the serve engine: every compiled
+    program call goes through here (and, per smklint SMK114, only
+    ever from inside a ``run_under_deadline`` worker). The chaos
+    injectors (smk_tpu/testing/faults.py ``stall_predict`` /
+    ``inject_predict_nan``) wrap this function while armed —
+    ``prog_key`` identifies the program kind, so injectors target
+    predict dispatches and never the guard."""
+    return prog(*args)
+
+
+class PredictionEngine:
+    """Serve one fit artifact. See the module docstring for the
+    failure-semantics contract; constructor knobs:
+
+    ``artifact``: a :class:`FitArtifact` or a path to one.
+    ``buckets``: the query-batch shape ladder; a request is split
+    into slices of at most ``max(buckets)`` rows and each slice pads
+    up to the smallest bucket that holds it.
+    ``max_queue`` / ``max_in_flight``: admission control bounds.
+    ``default_deadline_s``: per-request budget when the request
+    carries none.
+    ``compile_store_dir``: the ISSUE 8 L2 store — point a fleet of
+    engines at one warm store and none of them ever compiles.
+    ``warm``: AOT-compile the whole ladder at construction (the
+    production default); ``warm=False`` defers every program to its
+    first request — the measured "cold" configuration of the
+    BENCH_SERVE rung.
+    ``run_log_dir``: arm the PR 9 run log (one serve-session log,
+    request spans nested under it).
+    """
+
+    def __init__(
+        self,
+        artifact,
+        *,
+        buckets=DEFAULT_BUCKETS,
+        max_queue: int = 16,
+        max_in_flight: int = 1,
+        default_deadline_s: float = 30.0,
+        degraded_threshold: int = DEFAULT_DEGRADED_THRESHOLD,
+        compile_store_dir: Optional[str] = None,
+        run_log_dir: Optional[str] = None,
+        warm: bool = True,
+        include_samples: bool = False,
+        pipeline_stats=None,
+    ):
+        import jax
+
+        if isinstance(artifact, (str, bytes)) or hasattr(
+            artifact, "__fspath__"
+        ):
+            artifact = load_artifact(artifact)
+        if not isinstance(artifact, FitArtifact):
+            raise TypeError(
+                "artifact must be a FitArtifact or a path to one"
+            )
+        self.artifact = artifact
+        bs = tuple(sorted({int(b) for b in buckets}))
+        if not bs or bs[0] <= 0:
+            raise ValueError(
+                f"buckets must be positive ints, got {buckets!r}"
+            )
+        self.buckets = bs
+        if max_queue < 1 or max_in_flight < 1:
+            raise ValueError(
+                "max_queue and max_in_flight must be >= 1"
+            )
+        self.max_queue = int(max_queue)
+        self.max_in_flight = int(max_in_flight)
+        self.default_deadline_s = float(default_deadline_s)
+        self.degraded_threshold = int(degraded_threshold)
+        self.include_samples = bool(include_samples)
+        self._queue_sem = threading.BoundedSemaphore(self.max_queue)
+        self._inflight = threading.BoundedSemaphore(self.max_in_flight)
+        self._lock = threading.Lock()
+        self._ids = itertools.count()
+        self._state = "ready"
+        self._warm = False
+        self._consecutive_trips = 0
+        self._stats = {
+            "requests_served": 0,
+            "requests_shed": 0,
+            "requests_timed_out": 0,
+            "requests_rejected": 0,
+            "requests_degraded": 0,
+            "rows_degraded": 0,
+        }
+        if pipeline_stats is None:
+            from smk_tpu.utils.tracing import ChunkPipelineStats
+
+            pipeline_stats = ChunkPipelineStats()
+        self.pstats = pipeline_stats
+        self._store = None
+        if compile_store_dir:
+            from smk_tpu.compile.store import ProgramStore
+
+            self._store = ProgramStore(compile_store_dir)
+        self.run_log = None
+        if run_log_dir:
+            from smk_tpu.obs.events import open_run_log
+
+            self.run_log = open_run_log(
+                run_log_dir, name="serve",
+                meta={
+                    "n_draws": artifact.n_draws,
+                    "n_anchor": artifact.n_anchor,
+                    "q": artifact.q,
+                    "buckets": list(bs),
+                    "config_digest": artifact.config_digest,
+                },
+            )
+        # device-committed constants, put once — requests only ship
+        # the (padded) query slice and a seed
+        dt = artifact.sample_w.dtype
+        t, q, p = artifact.n_anchor, artifact.q, artifact.p
+        s = artifact.n_draws
+        self._dtype = dt
+        self._const = tuple(
+            jax.device_put(np.asarray(a, dt)) for a in (
+                artifact.chol_tt,
+                artifact.sample_w.reshape(s, t, q),
+                artifact.sample_par[:, : q * p].reshape(s, q, p),
+                artifact.phi,
+                artifact.coords_test,
+            )
+        )
+        if warm:
+            self.warm()
+
+    # -- program acquisition (L1/L2, ISSUE 8) ----------------------
+
+    def _predict_key(self, u: int) -> tuple:
+        a = self.artifact
+        return (
+            "serve_predict", int(u), a.n_draws, a.n_anchor, a.q,
+            a.p, a.coord_dim, str(self._dtype), a.cov_model, a.link,
+            a.serve_digest(),
+        )
+
+    def _guard_key(self, u: int) -> tuple:
+        a = self.artifact
+        return (
+            "serve_guard", int(u), a.n_draws, a.q,
+            str(self._dtype), a.serve_digest(),
+        )
+
+    def _build_predict(self, u: int):
+        import jax
+
+        from smk_tpu.api import _krige_predict_core
+        from smk_tpu.ops.quantiles import credible_summary
+
+        a = self.artifact
+        s, q = a.n_draws, a.q
+        cov_model, link = a.cov_model, a.link
+        var_floor = a.var_floor()
+
+        def fn(chol_tt, w_test, betas, phi, coords_test,
+               coords_q, x_q, seed):
+            key = jax.random.key(seed)
+            eps = jax.random.normal(key, (s, u, q), w_test.dtype)
+            ps = _krige_predict_core(
+                chol_tt, w_test, betas, phi, coords_test,
+                coords_q, x_q, eps,
+                cov_model=cov_model, link=link, var_floor=var_floor,
+            )
+            pq = credible_summary(ps.reshape(s, -1)).reshape(3, u, q)
+            return ps, pq
+
+        return jax.jit(fn)
+
+    def _build_guard(self, u: int):
+        import jax
+        import jax.numpy as jnp
+
+        def fn(ps):
+            # per-row finiteness of the (S, u, q) draw stack — the
+            # K+4-byte _chunk_stats pattern: a tiny SEPARATE program
+            # (fusing it into predict would change that program's
+            # module context and break the bit-identity pins), u
+            # bytes home per slice
+            return jnp.isfinite(ps).all(axis=(0, 2))
+
+        return jax.jit(fn)
+
+    def _lower_args(self, u: int):
+        import jax
+
+        a = self.artifact
+        dt = self._dtype
+        s, t, q, p, d = (
+            a.n_draws, a.n_anchor, a.q, a.p, a.coord_dim,
+        )
+        sd = jax.ShapeDtypeStruct
+        return (
+            sd((q, t, t), dt), sd((s, t, q), dt), sd((s, q, p), dt),
+            sd((q,), dt), sd((t, d), dt), sd((u, d), dt),
+            sd((u, q, p), dt), sd((), np.uint32),
+        )
+
+    def _programs(self, u: int):
+        """(predict, guard) compiled programs for bucket ``u`` via
+        the L1 → L2 → AOT-build lookup (compile/programs) — warm
+        engines resolve from L1, fresh processes on a warm store
+        deserialize from L2, and only a cold store-less engine pays
+        compile (off the request path when ``warm=True``)."""
+        import jax
+
+        from smk_tpu.compile.programs import get_program
+
+        pred = get_program(
+            self, self._predict_key(u), lambda: self._build_predict(u),
+            store=self._store, lower_args=self._lower_args(u),
+            stats=self.pstats,
+        )
+        a = self.artifact
+        guard = get_program(
+            self, self._guard_key(u), lambda: self._build_guard(u),
+            store=self._store,
+            lower_args=(jax.ShapeDtypeStruct(
+                (a.n_draws, u, a.q), self._dtype
+            ),),
+            stats=self.pstats,
+        )
+        return pred, guard
+
+    def warm(self) -> dict:
+        """AOT-compile (or L2-load) every ladder bucket's predict and
+        guard program, then run ONE throwaway dispatch on the
+        smallest bucket (bounded — even warm-up obeys SMK111/114) so
+        the first real request touches nothing cold. Returns the
+        program-source summary (all-``l2`` on a warm store)."""
+        for u in self.buckets:
+            self._programs(u)
+        u0 = self.buckets[0]
+        pred, guard = self._programs(u0)
+        a = self.artifact
+        coords_q = np.repeat(
+            np.asarray(a.coords_test[:1], self._dtype), u0, axis=0
+        )
+        x_q = np.zeros((u0, a.q, a.p), self._dtype)
+        budget = DeadlineBudget(_WARM_DEADLINE_S)
+
+        def worker():
+            ps, pq = _invoke_program(
+                pred, self._predict_key(u0), *self._const,
+                coords_q, x_q, np.uint32(0),
+            )
+            mask = _invoke_program(
+                guard, self._guard_key(u0), ps
+            )
+            return np.asarray(mask)
+
+        run_under_deadline(
+            worker, budget, label="warmup", phase="dispatch",
+            run_log=self.run_log,
+        )
+        self._warm = True
+        if self.run_log is not None:
+            self.run_log.event(
+                "warm", buckets=list(self.buckets),
+                sources=self.program_summary(),
+            )
+        return self.program_summary()
+
+    def program_summary(self) -> dict:
+        summ = getattr(self.pstats, "program_summary", None)
+        return summ() if summ is not None else {}
+
+    # -- admission + serving ---------------------------------------
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def _count(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            self._stats[field] += n
+
+    def _note_guard(self, n_degraded: int) -> None:
+        with self._lock:
+            if n_degraded > 0:
+                self._stats["requests_degraded"] += 1
+                self._stats["rows_degraded"] += int(n_degraded)
+                self._consecutive_trips += 1
+                if (
+                    self._consecutive_trips >= self.degraded_threshold
+                    and self._state == "ready"
+                ):
+                    self._state = "degraded"
+                    if self.run_log is not None:
+                        self.run_log.event(
+                            "health", state="degraded",
+                            consecutive_trips=self._consecutive_trips,
+                        )
+            else:
+                self._consecutive_trips = 0
+                if self._state == "degraded":
+                    self._state = "ready"
+                    if self.run_log is not None:
+                        self.run_log.event("health", state="ready")
+
+    def predict(
+        self,
+        coords_query,
+        x_query,
+        *,
+        deadline_s: Optional[float] = None,
+        seed: int = 0,
+        request_id: Optional[str] = None,
+    ) -> PredictResponse:
+        """Serve one query batch; see :class:`PredictResponse`.
+
+        Deterministic: the same (artifact, query batch, seed) always
+        returns bit-identical predictions, engine to engine and
+        process to process (same shapes → same executables; the
+        composition noise is derived from ``seed`` alone). Raises
+        :class:`~smk_tpu.api.QueryValidationError` before any
+        dispatch, :class:`QueueFullError` / :class:`RequestTimeoutError`
+        / :class:`EngineDrainingError` per the admission contract.
+        """
+        from smk_tpu.api import validate_query_batch
+
+        if self._state == "draining":
+            self._count("requests_rejected")
+            raise EngineDrainingError(
+                "engine is draining — no new requests"
+            )
+        a = self.artifact
+        cq, xq = validate_query_batch(
+            coords_query, x_query, d=a.coord_dim, q=a.q, p=a.p
+        )
+        rid = request_id or f"r{next(self._ids)}"
+        budget = DeadlineBudget(
+            deadline_s if deadline_s is not None
+            else self.default_deadline_s
+        )
+        if not self._queue_sem.acquire(blocking=False):  # smklint: disable=SMK111 -- blocking=False is a zero-wait poll: the shed path must reject IMMEDIATELY, which is stricter than any timeout
+            self._count("requests_shed")
+            raise QueueFullError(self.max_queue)
+        try:
+            got = self._inflight.acquire(timeout=budget.remaining())
+            if not got:
+                self._count("requests_timed_out")
+                raise RequestTimeoutError(
+                    rid, "queued", budget.total_s
+                )
+        finally:
+            self._queue_sem.release()
+        try:
+            return self._serve(cq, xq, rid, int(seed), budget)
+        except RequestTimeoutError:
+            # dispatch/guard overrun: the worker is abandoned (it
+            # holds no locks) and the slot frees in the finally — the
+            # NEXT request dispatches fresh, which is the "engine
+            # keeps serving" half of the deadline contract
+            self._count("requests_timed_out")
+            raise
+        finally:
+            self._inflight.release()
+
+    def _serve(self, cq, xq, rid, seed, budget) -> PredictResponse:
+        import contextlib
+
+        n = cq.shape[0]
+        queued_s = budget.elapsed()
+        log = self.run_log
+        span = (
+            log.span("request", id=rid, n=int(n),
+                     queued_s=round(queued_s, 6))
+            if log is not None else contextlib.nullcontext()
+        )
+        cap = self.buckets[-1]
+        pq_parts, ps_parts, mask_parts, used = [], [], [], []
+        with span:
+            for lo in range(0, n, cap):
+                if budget.expired():
+                    # an exhausted budget sheds typed BEFORE the
+                    # device is touched — dispatching a slice that is
+                    # guaranteed to overrun would stack abandoned
+                    # device work behind the next admitted request
+                    raise RequestTimeoutError(
+                        rid, "dispatch", budget.total_s
+                    )
+                sl_c = cq[lo: lo + cap]
+                sl_x = xq[lo: lo + cap]
+                u = self._bucket_for(sl_c.shape[0])
+                used.append(u)
+                bspan = (
+                    log.span("bucket", bucket=u,
+                             rows=int(sl_c.shape[0]))
+                    if log is not None else contextlib.nullcontext()
+                )
+                with bspan:
+                    pqp, psp, maskp = self._dispatch_slice(
+                        sl_c, sl_x, u, rid, seed + lo, budget
+                    )
+                pq_parts.append(pqp)
+                mask_parts.append(maskp)
+                if psp is not None:
+                    ps_parts.append(psp)
+        p_quant = np.concatenate(pq_parts, axis=1)
+        rows_finite = np.concatenate(mask_parts)
+        rows_degraded = ~rows_finite
+        self._note_guard(int(rows_degraded.sum()))
+        self._count("requests_served")
+        return PredictResponse(
+            p_quant=p_quant,
+            rows_degraded=rows_degraded,
+            p_samples=(
+                np.concatenate(ps_parts, axis=1)
+                if ps_parts else None
+            ),
+            buckets=tuple(used),
+            request_id=rid,
+            latency_s=budget.elapsed(),
+        )
+
+    def _dispatch_slice(self, sl_c, sl_x, u, rid, seed, budget):
+        """One micro-batch slice through its bucket: pad → dispatch →
+        guard, every device wait under the request deadline. Pad rows
+        repeat the slice's first query (guaranteed-finite content —
+        they are sliced away before the response and, the composition
+        draw being row-independent, arithmetically invisible to real
+        rows)."""
+        import contextlib
+
+        log = self.run_log
+        n_sl = sl_c.shape[0]
+        pad = u - n_sl
+        if pad:
+            sl_c = np.concatenate(
+                [sl_c, np.repeat(sl_c[:1], pad, axis=0)]
+            )
+            sl_x = np.concatenate(
+                [sl_x, np.zeros((pad,) + sl_x.shape[1:], sl_x.dtype)]
+            )
+        pred, guard = self._programs(u)
+        label = f"{rid}/bucket{u}"
+        pkey, gkey = self._predict_key(u), self._guard_key(u)
+        const = self._const
+        sl_c = sl_c.astype(self._dtype, copy=False)
+        sl_x = sl_x.astype(self._dtype, copy=False)
+        seed_arr = np.uint32(seed & 0xFFFFFFFF)
+
+        def dispatch_worker():
+            return _invoke_program(
+                pred, pkey, *const, sl_c, sl_x, seed_arr
+            )
+
+        dspan = (
+            log.span("dispatch", bucket=u)
+            if log is not None else contextlib.nullcontext()
+        )
+        with dspan:
+            ps, pq = run_under_deadline(
+                dispatch_worker, budget, label=label,
+                phase="dispatch", run_log=log,
+            )
+
+        include_samples = self.include_samples
+
+        def guard_worker():
+            mask = np.asarray(_invoke_program(guard, gkey, ps))
+            # the response D2H happens HERE, inside the deadline: jax
+            # dispatch is async, so the fetch is where a wedged
+            # device/transfer actually surfaces — it must convert to
+            # a typed timeout like every other device wait (the
+            # engine's own SMK114 invariant)
+            pq_np = np.asarray(pq)
+            ps_np = np.asarray(ps) if include_samples else None
+            return mask, pq_np, ps_np
+
+        gspan = (
+            log.span("guard", bucket=u)
+            if log is not None else contextlib.nullcontext()
+        )
+        with gspan:
+            mask, pq_np, ps_np = run_under_deadline(
+                guard_worker, budget, label=label,
+                phase="guard", run_log=log,
+            )
+        return (
+            pq_np[:, :n_sl],
+            ps_np[:, :n_sl] if ps_np is not None else None,
+            mask[:n_sl],
+        )
+
+    # -- health ----------------------------------------------------
+
+    def health(self) -> dict:
+        """Liveness/readiness snapshot for external probes:
+        ``state`` in {"ready", "degraded", "draining"} plus the
+        admission/degradation counters. Cheap (no device work)."""
+        with self._lock:
+            out = dict(self._stats)
+            out["state"] = self._state
+            out["ready"] = self._state == "ready"
+            out["warm"] = self._warm
+            out["consecutive_guard_trips"] = self._consecutive_trips
+            out["buckets"] = list(self.buckets)
+            out["max_queue"] = self.max_queue
+            out["max_in_flight"] = self.max_in_flight
+        return out
+
+    def drain(self) -> None:
+        """Enter draining: new requests are rejected typed
+        (:class:`EngineDrainingError`), in-flight requests finish."""
+        with self._lock:
+            self._state = "draining"
+        if self.run_log is not None:
+            self.run_log.event("health", state="draining")
+
+    def close(self) -> None:
+        self.drain()
+        if self.run_log is not None:
+            self.run_log.close(serve=self.health())
+            self.run_log = None
+
+    def __enter__(self) -> "PredictionEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -58,6 +58,18 @@ Distributed-checkpoint injectors (ISSUE 13, parallel/checkpoint.py):
   post-hoc file-damage scenario the lenient cross-host hole handling
   (quarantine resume) re-samples.
 
+Serving injectors (ISSUE 14, smk_tpu/serve/):
+
+- :func:`stall_predict` — block the serve engine's next predict
+  dispatches inside the dispatch itself (wedged-device analog) until
+  the context exits or a bounded fallback, so the request deadline
+  (serve/deadline.run_under_deadline) converts the hang into a typed
+  ``RequestTimeoutError`` while the engine keeps serving.
+- :func:`inject_predict_nan` — poison chosen OUTPUT rows of the next
+  predict dispatches to NaN (the sick-row device-fault analog,
+  planted after validation so it travels the genuine per-row guard /
+  partial-response / health-state path).
+
 smklint rule SMK108: these APIs may be imported/armed only under
 ``tests/`` and ``scripts/`` — a reference in ``smk_tpu/`` library
 code ships chaos to production fits and is a lint finding.
@@ -536,3 +548,139 @@ def kill_at_manifest(nth: int):
         yield counter
     finally:
         _recovery._SegmentedCheckpoint._write_manifest = real
+
+
+# ---------------------------------------------------------------------------
+# serving injectors (ISSUE 14, smk_tpu/serve/)
+# ---------------------------------------------------------------------------
+
+_serve_patched = False
+_active_predict_stall: list = []
+_active_predict_nan: list = []
+
+
+@dataclass
+class PredictStallInjection:
+    """Arming state of :func:`stall_predict`: the next ``max_fires``
+    predict dispatches block inside the dispatch on ``release`` (set
+    on context exit — zero residue) or the bounded ``max_stall_s``
+    fallback."""
+
+    max_fires: int = 1
+    max_stall_s: float = 600.0
+    fires: int = 0
+    release: threading.Event = field(default_factory=threading.Event)
+
+
+@dataclass
+class PredictNaNInjection:
+    """Arming state of :func:`inject_predict_nan`: the next
+    ``max_fires`` predict dispatches return with ``rows`` of their
+    output poisoned to NaN."""
+
+    rows: tuple
+    max_fires: int = 1
+    fires: int = 0
+
+
+@jax.jit
+def _poison_predict_rows(arr, rows):
+    """NaN the chosen query rows (axis 1) of a predict output — the
+    sick-row device-fault analog the per-row guard quarantines."""
+    return arr.at[:, rows].set(jnp.nan)
+
+
+def _ensure_serve_patched() -> None:
+    global _serve_patched
+    with _arm_lock:
+        if _serve_patched:
+            return
+        from smk_tpu.serve import engine as _serve_engine
+
+        real = _serve_engine._invoke_program
+
+        def invoking(prog, prog_key, *args):
+            # wrap ONLY predict dispatches (never the guard — the
+            # guard must observe the damage), ONLY while armed
+            if (
+                not (_active_predict_stall or _active_predict_nan)
+                or prog_key[0] != "serve_predict"
+            ):
+                return real(prog, prog_key, *args)
+            # fire-count check-and-increment under the arm lock:
+            # concurrent dispatches (max_in_flight > 1) must not race
+            # past max_fires — the injectors' determinism contract
+            with _arm_lock:
+                stalls = [
+                    st for st in _active_predict_stall
+                    if st.fires < st.max_fires
+                ]
+                for st in stalls:
+                    st.fires += 1
+            for st in stalls:
+                st.release.wait(timeout=st.max_stall_s)
+            out = real(prog, prog_key, *args)
+            hits: list = []
+            with _arm_lock:
+                for inj in list(_active_predict_nan):
+                    if inj.fires < inj.max_fires:
+                        inj.fires += 1
+                        hits.extend(inj.rows)
+            if not hits:
+                return out
+            rows = jnp.asarray(sorted(set(hits)), jnp.int32)
+            ps, pq = out
+            return (
+                _poison_predict_rows(ps, rows),
+                _poison_predict_rows(pq, rows),
+            )
+
+        _serve_engine._invoke_program = invoking
+        _serve_patched = True
+
+
+@contextmanager
+def stall_predict(max_fires: int = 1, max_stall_s: float = 600.0):
+    """Arm a wedged-predict simulation: the serve engine's next
+    ``max_fires`` predict dispatches block inside the dispatch until
+    this context exits (the ``finally`` sets the release event — no
+    thread outlives the scope unbounded) or ``max_stall_s`` elapses.
+    The request deadline fires during the stall and raises the typed
+    ``RequestTimeoutError`` naming the in-flight batch — the
+    protocol's stalled-dispatch leg; the abandoned worker unblocks at
+    context exit and its late result is discarded. Yields the
+    injection record."""
+    _ensure_serve_patched()
+    inj = PredictStallInjection(
+        max_fires=int(max_fires), max_stall_s=float(max_stall_s)
+    )
+    with _arm_lock:
+        _active_predict_stall.append(inj)
+    try:
+        yield inj
+    finally:
+        with _arm_lock:
+            _active_predict_stall.remove(inj)
+        inj.release.set()
+
+
+@contextmanager
+def inject_predict_nan(rows, max_fires: int = 1):
+    """Arm a sick-row injection: the serve engine's next
+    ``max_fires`` predict dispatches come back with query ``rows``
+    (bucket-padded indices, axis 1 of the output) poisoned to NaN —
+    planted AFTER query validation, so the damage travels the
+    genuine guard-program / per-row quarantine / partial-response /
+    health-state path exactly as a flaky device would feed it.
+    Yields the injection record."""
+    _ensure_serve_patched()
+    inj = PredictNaNInjection(
+        rows=tuple(int(r) for r in rows), max_fires=int(max_fires)
+    )
+    with _arm_lock:
+        _active_predict_nan.append(inj)
+    try:
+        yield inj
+    finally:
+        with _arm_lock:
+            _active_predict_nan.remove(inj)

@@ -526,3 +526,39 @@ class TestInputShapeValidation:
                     n_subsets=2, n_samples=20, burn_in_frac=0.5
                 ), **args,
             )
+
+
+class TestServePassThrough:
+    def test_predict_serve_wired(self):
+        """The ISSUE 14 front-end addition: R ``smk.predict.serve``
+        must exist, route artifact.path/deadline.ms into the
+        serving engine (``PredictionEngine`` + ``predict`` with
+        ``deadline_s`` in seconds), and surface the partial-response
+        contract (``rows.degraded`` mask + ``health``) in the result
+        list (source-checked — the engine itself is exercised
+        end-to-end in tests/test_serve.py)."""
+        import os
+
+        r_src = open(
+            os.path.join(
+                os.path.dirname(os.path.dirname(__file__)),
+                "r", "meta_kriging_tpu.R",
+            )
+        ).read()
+        assert "smk.predict.serve <- function(artifact.path" in r_src
+        assert "deadline.ms = NULL" in r_src
+        # the engine is cached per (artifact, store) — rebuilding it
+        # per call would re-pay warm-up compile on every predict
+        assert ".smk.serve.engines" in r_src
+        assert "get0(eng_key, envir = .smk.serve.engines)" in r_src
+        # the cache key carries the file's identity (mtime + size) so
+        # a re-saved artifact at the same path builds a FRESH engine
+        # instead of silently serving the stale fit
+        assert "file.info(artifact.path)" in r_src
+        assert 'as.numeric(art_info$mtime), "|", art_info$size' in r_src
+        assert "args$deadline_s <- deadline.ms / 1000" in r_src
+        assert "serve$PredictionEngine" in r_src
+        assert "compile_store_dir <- compile.store.dir" in r_src
+        assert "rows.degraded = as.logical(to_r(res$rows_degraded))" \
+            in r_src
+        assert "health = eng$health()" in r_src

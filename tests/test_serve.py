@@ -1,0 +1,606 @@
+"""Serving engine tests (ISSUE 14, smk_tpu/serve/).
+
+In-gate legs share ONE small real fit (m=16 program set — the module
+fixture below) and one engine program set served through a shared L2
+store, so the marginal cost of every test after the first is
+milliseconds: artifact round-trip + corruption typed errors, the
+factor-reuse regression (predict call 2 performs ZERO m-sized
+factorizations), query validation, bucket-ladder selection incl. the
+pad-row identity, queue shedding, deadline math, degraded
+partial-response masks with bitwise-healthy rows, health-state
+transitions, and the request span tree. Heavy concurrency legs are
+slow-marked.
+"""
+
+# smklint: test-budget=one shared m=16 fit (~14 s) + one serve program set (~4 s) module-wide; every test after the fixtures measures milliseconds
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from smk_tpu.api import (
+    QueryValidationError,
+    predict_at,
+)
+from smk_tpu.config import SMKConfig
+from smk_tpu.serve import (
+    ArtifactError,
+    DeadlineBudget,
+    EngineDrainingError,
+    PredictionEngine,
+    QueueFullError,
+    RequestTimeoutError,
+    load_artifact,
+    run_under_deadline,
+    save_artifact,
+)
+
+K, N, Q, P, T = 4, 64, 1, 2, 6
+CFG = SMKConfig(
+    n_subsets=K, n_samples=24, burn_in_frac=0.5,
+    n_quantiles=21, resample_size=40,
+)
+
+
+def _problem():
+    rng = np.random.default_rng(7)
+    coords = rng.uniform(size=(N, 2)).astype(np.float32)
+    x = rng.normal(size=(N, Q, P)).astype(np.float32)
+    y = rng.integers(0, 2, size=(N, Q)).astype(np.float32)
+    ct = rng.uniform(size=(T, 2)).astype(np.float32)
+    xt = rng.normal(size=(T, Q, P)).astype(np.float32)
+    return y, x, coords, ct, xt
+
+
+def _queries(n, seed=11):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.uniform(size=(n, 2)).astype(np.float32),
+        rng.normal(size=(n, Q, P)).astype(np.float32),
+    )
+
+
+@pytest.fixture(scope="module")
+def fit_and_anchor():
+    """ONE real small fit (the module's m=16 program set) — every
+    serve test below reuses its result and anchor grid."""
+    from smk_tpu.api import fit_meta_kriging
+
+    y, x, coords, ct, xt = _problem()
+    res = fit_meta_kriging(
+        jax.random.key(0), y, x, coords, ct, xt, config=CFG
+    )
+    return res, ct
+
+
+@pytest.fixture(scope="module")
+def serve_dirs(tmp_path_factory):
+    root = tmp_path_factory.mktemp("serve")
+    return str(root / "fit.artifact.npz"), str(root / "store")
+
+
+@pytest.fixture(scope="module")
+def artifact_path(fit_and_anchor, serve_dirs):
+    res, ct = fit_and_anchor
+    path, _ = serve_dirs
+    save_artifact(path, res, ct, config=CFG)
+    return path
+
+
+@pytest.fixture(scope="module")
+def engine(artifact_path, serve_dirs):
+    """The module's ONE warm engine (pays the serve program set once,
+    into the shared store — every other engine in this file L2-loads
+    from it)."""
+    _, store = serve_dirs
+    return PredictionEngine(
+        artifact_path, buckets=(4, 8), compile_store_dir=store,
+        default_deadline_s=30.0,
+    )
+
+
+def _fresh_engine(artifact_path, serve_dirs, **kw):
+    _, store = serve_dirs
+    kw.setdefault("buckets", (4, 8))
+    kw.setdefault("compile_store_dir", store)
+    kw.setdefault("default_deadline_s", 30.0)
+    return PredictionEngine(artifact_path, **kw)
+
+
+class TestArtifact:
+    def test_round_trip(self, fit_and_anchor, artifact_path):
+        res, ct = fit_and_anchor
+        art = load_artifact(artifact_path)
+        assert art.q == Q and art.p == P
+        assert art.n_anchor == T and art.coord_dim == 2
+        np.testing.assert_array_equal(
+            art.sample_w, np.asarray(res.sample_w, np.float32)
+        )
+        np.testing.assert_array_equal(
+            art.param_grid, np.asarray(res.param_grid, np.float32)
+        )
+        np.testing.assert_array_equal(
+            art.coords_test, ct.astype(np.float32)
+        )
+        # the plug-in phi is the combined posterior median: row i of
+        # the grid holds probability (i+1)/n, so the median row is
+        # (n+1)//2 - 1 — NOT n//2, which is half a grid step high
+        mid = (np.asarray(res.param_grid).shape[0] + 1) // 2 - 1
+        np.testing.assert_array_equal(
+            art.phi, np.asarray(res.param_grid)[mid, -Q:]
+        )
+        assert np.isfinite(art.chol_tt).all()
+        assert art.cov_model == CFG.cov_model
+        assert art.link == CFG.link
+
+    def test_missing_file_typed(self, tmp_path):
+        with pytest.raises(ArtifactError, match="no serving artifact"):
+            load_artifact(str(tmp_path / "absent.npz"))
+
+    def test_truncation_typed(self, artifact_path, tmp_path):
+        torn = str(tmp_path / "torn.npz")
+        raw = open(artifact_path, "rb").read()
+        with open(torn, "wb") as f:
+            f.write(raw[: len(raw) // 2])
+        with pytest.raises(ArtifactError):
+            load_artifact(torn)
+
+    def test_payload_bitflip_fails_crc(self, artifact_path, tmp_path):
+        """np.savez stores arrays uncompressed — most single-byte
+        flips land silently in array data where only the CRC can see
+        them (the checkpoint segment_checksum rationale)."""
+        bad = str(tmp_path / "flipped.npz")
+        raw = bytearray(open(artifact_path, "rb").read())
+        raw[len(raw) // 2] ^= 0xFF
+        with open(bad, "wb") as f:
+            f.write(bytes(raw))
+        with pytest.raises(ArtifactError):
+            load_artifact(bad)
+
+    def test_meta_field_flip_fails_crc(self, artifact_path, tmp_path):
+        """The CRC covers the SCALAR/STRING fields too: a perturbed
+        jitter re-saved with the stale checksum (the flip only the
+        CRC can catch — shapes and zip structure stay valid) must be
+        a typed error, never a silent mis-serve with a different
+        variance floor."""
+        with np.load(artifact_path) as d:
+            arrays = {k: np.asarray(d[k]) for k in d.files}
+        arrays["jitter"] = arrays["jitter"] * 2.0
+        bad = str(tmp_path / "meta_flip.npz")
+        np.savez(bad, **arrays)  # stale crc retained
+        with pytest.raises(ArtifactError, match="checksum"):
+            load_artifact(bad)
+
+    def test_not_an_artifact_typed(self, tmp_path):
+        other = str(tmp_path / "other.npz")
+        np.savez(other, a=np.zeros(3))
+        with pytest.raises(ArtifactError, match="missing fields"):
+            load_artifact(other)
+
+
+class TestPluginPhi:
+    def test_median_row_exact_on_even_grids(self):
+        """Row i of a combined quantile grid holds probability
+        (i+1)/n (ops/quantiles.quantile_probs), so the plug-in phi
+        row is (n+1)//2 - 1; the historical n//2 selected the 50.5%
+        quantile on the default n_quantiles=200 grid."""
+        from smk_tpu.api import _median_row
+        from smk_tpu.ops.quantiles import quantile_probs
+
+        for n in (20, 200):
+            probs = np.asarray(quantile_probs(n))
+            assert probs[_median_row(n)] == pytest.approx(
+                0.5, abs=1e-6
+            )
+            assert probs[n // 2] > 0.5 + 1e-4  # the old index
+        assert _median_row(21) == 10  # odd grids: upper neighbor
+
+    def test_artifact_and_library_path_share_layout(
+        self, fit_and_anchor, artifact_path
+    ):
+        """save_artifact and predict_at run the SAME layout/phi
+        inference (api.plugin_phi_layout) — the frozen artifact can
+        never disagree with the library path on serving geometry."""
+        from smk_tpu.api import plugin_phi_layout
+
+        res, ct = fit_and_anchor
+        q, p, phi = plugin_phi_layout(res, ct.shape[0])
+        art = load_artifact(artifact_path)
+        assert (art.q, art.p) == (q, p)
+        np.testing.assert_array_equal(
+            art.phi, phi.astype(np.float32)
+        )
+
+    def test_layout_rejects_mismatched_anchor_grid(
+        self, fit_and_anchor
+    ):
+        """An anchor size that is not the fit's true t must be a
+        typed error, never a silent wrong layout: t/2 floor-divides
+        into a DIFFERENT (q, p) whose reshape would succeed on sheer
+        element count and mis-serve, and a non-divisor t used to die
+        in a raw reshape deep in the kriging."""
+        from smk_tpu.api import QueryValidationError, plugin_phi_layout
+
+        res, ct = fit_and_anchor
+        t = ct.shape[0]
+        for bad_t in (t // 2, t - 1, 3 * t):
+            with pytest.raises(QueryValidationError):
+                plugin_phi_layout(res, bad_t)
+
+
+class TestPredictAtFactorReuse:
+    def test_second_predict_zero_factor_rebuilds(self, fit_and_anchor):
+        """The ISSUE 14 hot-path fix: threading the FactorCache
+        through repeated predicts on one fit means call 2 performs
+        ZERO m-sized factorizations (n_chol frozen) and returns
+        bit-identical probabilities."""
+        res, ct = fit_and_anchor
+        cq, xq = _queries(5)
+        out1, cache1 = predict_at(
+            res, ct, cq, xq, key=jax.random.key(3), config=CFG
+        )
+        n1 = int(cache1.n_chol)
+        assert n1 == Q  # one anchor factorization per component
+        out2, cache2 = predict_at(
+            res, ct, cq, xq, key=jax.random.key(3), config=CFG,
+            cache=cache1,
+        )
+        assert int(cache2.n_chol) == n1  # ZERO rebuilds on call 2
+        np.testing.assert_array_equal(
+            np.asarray(out1.p_samples), np.asarray(out2.p_samples)
+        )
+        assert np.isfinite(np.asarray(out1.p_quant)).all()
+        assert out1.p_quant.shape == (3, 5, Q)
+
+
+class TestQueryValidation:
+    def test_typed_rejections(self, engine):
+        cq, xq = _queries(3)
+        bad_c = cq.copy()
+        bad_c[1, 0] = np.nan
+        with pytest.raises(QueryValidationError, match="rows \\[1\\]"):
+            engine.predict(bad_c, xq)
+        bad_x = xq.copy()
+        bad_x[2] = np.inf
+        with pytest.raises(QueryValidationError, match="x_query"):
+            engine.predict(cq, bad_x)
+        with pytest.raises(QueryValidationError, match="empty"):
+            engine.predict(cq[:0], xq[:0])
+        with pytest.raises(QueryValidationError, match="d=2"):
+            engine.predict(cq[:, :1], xq)
+        with pytest.raises(QueryValidationError, match="x_query"):
+            engine.predict(cq, xq[:2])
+
+    def test_rejected_before_any_dispatch(self, engine):
+        served = engine.health()["requests_served"]
+        cq, xq = _queries(3)
+        bad = cq.copy()
+        bad[0] = np.inf
+        with pytest.raises(QueryValidationError):
+            engine.predict(bad, xq)
+        assert engine.health()["requests_served"] == served
+
+
+class TestBucketLadder:
+    def test_selection_and_micro_batching(self, engine):
+        cq3, xq3 = _queries(3)
+        r = engine.predict(cq3, xq3)
+        assert r.buckets == (4,)
+        assert r.p_quant.shape == (3, 3, Q)
+        cq5, xq5 = _queries(5)
+        assert engine.predict(cq5, xq5).buckets == (8,)
+        cq9, xq9 = _queries(9)
+        r9 = engine.predict(cq9, xq9)
+        assert r9.buckets == (8, 4)  # split at the ladder cap
+        assert r9.p_quant.shape == (3, 9, Q)
+        assert not r9.rows_degraded.any()
+
+    def test_pad_row_identity(self, engine):
+        """Two batches sharing their first 3 queries, padded into the
+        SAME bucket with different tail content: the shared rows are
+        BIT-identical — the composition draw is row-independent, so
+        neither pad rows nor neighbor queries can perturb a row."""
+        cq, xq = _queries(4, seed=21)
+        cq_alt, xq_alt = _queries(4, seed=22)
+        cq_alt[:3], xq_alt[:3] = cq[:3], xq[:3]
+        r1 = engine.predict(cq, xq, seed=5)
+        r2 = engine.predict(cq_alt, xq_alt, seed=5)
+        np.testing.assert_array_equal(
+            r1.p_quant[:, :3], r2.p_quant[:, :3]
+        )
+        assert not (r1.p_quant[:, 3] == r2.p_quant[:, 3]).all()
+
+    def test_deterministic_and_seed_sensitive(self, engine):
+        cq, xq = _queries(4)
+        a = engine.predict(cq, xq, seed=9)
+        b = engine.predict(cq, xq, seed=9)
+        np.testing.assert_array_equal(a.p_quant, b.p_quant)
+        c = engine.predict(cq, xq, seed=10)
+        assert not (a.p_quant == c.p_quant).all()
+
+
+class TestWarmStore:
+    def test_second_engine_serves_from_l2_zero_compiles(
+        self, engine, artifact_path, serve_dirs
+    ):
+        """A fresh engine on the warm store resolves every bucket
+        program from L2 and serves under recompile_guard(0) with
+        predictions bit-identical to the building engine — the
+        fresh-process version is the SERVE_r15 probe's acceptance
+        leg."""
+        from smk_tpu.analysis.sanitizers import recompile_guard
+
+        cq, xq = _queries(5)
+        ref = engine.predict(cq, xq, seed=3)
+        e2 = _fresh_engine(artifact_path, serve_dirs, warm=False)
+        with recompile_guard(max_compiles=0):
+            e2.warm()
+            got = e2.predict(cq, xq, seed=3)
+        srcs = e2.program_summary()["program_sources"]
+        assert set(srcs) == {"l2"}
+        np.testing.assert_array_equal(ref.p_quant, got.p_quant)
+
+
+class TestDeadlines:
+    def test_budget_math(self):
+        b = DeadlineBudget(10.0)
+        assert not b.expired()
+        assert 0 < b.remaining() <= 10.0
+        with pytest.raises(ValueError):
+            DeadlineBudget(0.0)
+        tiny = DeadlineBudget(1e-9)
+        time.sleep(0.002)
+        assert tiny.expired()
+        # remaining never reaches 0 — waits stay bounded AND typed
+        assert tiny.remaining() == DeadlineBudget.MIN_WAIT_S
+
+    def test_run_under_deadline_result_exc_timeout(self):
+        b = DeadlineBudget(5.0)
+        assert run_under_deadline(
+            lambda: 42, b, label="ok"
+        ) == 42
+        with pytest.raises(KeyError):
+            run_under_deadline(
+                lambda: (_ for _ in ()).throw(KeyError("x")),
+                b, label="exc",
+            )
+        short = DeadlineBudget(0.05)
+        with pytest.raises(RequestTimeoutError) as ei:
+            run_under_deadline(
+                lambda: time.sleep(1.0), short, label="batch7",
+                phase="dispatch",
+            )
+        assert ei.value.label == "batch7"
+        assert ei.value.phase == "dispatch"
+        assert ei.value.deadline_s == 0.05
+
+    def test_stalled_dispatch_typed_and_engine_keeps_serving(
+        self, engine
+    ):
+        """The stalled-dispatch contract: a wedged predict program
+        becomes a typed RequestTimeoutError naming the in-flight
+        batch within the deadline, and the NEXT request serves
+        normally."""
+        from smk_tpu.testing.faults import stall_predict
+
+        cq, xq = _queries(3)
+        timed = engine.health()["requests_timed_out"]
+        with stall_predict(max_fires=1, max_stall_s=10.0) as inj:
+            t0 = time.monotonic()
+            with pytest.raises(RequestTimeoutError) as ei:
+                engine.predict(cq, xq, deadline_s=0.3)
+            wall = time.monotonic() - t0
+        assert inj.fires == 1
+        assert "bucket4" in ei.value.label
+        assert wall < 5.0  # in-deadline, not the stall duration
+        assert engine.health()["requests_timed_out"] == timed + 1
+        after = engine.predict(cq, xq)
+        assert np.isfinite(after.p_quant).all()
+        assert engine.health()["state"] == "ready"
+
+
+    def test_expired_budget_sheds_before_dispatch(
+        self, engine, monkeypatch
+    ):
+        """A request whose budget is already exhausted sheds typed
+        BEFORE any device dispatch — an overrun-guaranteed slice must
+        not stack abandoned device work behind the next request."""
+        import smk_tpu.serve.engine as eng_mod
+
+        calls = []
+        real = eng_mod._invoke_program
+        monkeypatch.setattr(
+            eng_mod, "_invoke_program",
+            lambda prog, key, *a: (
+                calls.append(key[0]) or real(prog, key, *a)
+            ),
+        )
+        budget = DeadlineBudget(1e-9)
+        time.sleep(0.002)
+        assert budget.expired()
+        cq, xq = _queries(3)
+        with pytest.raises(RequestTimeoutError) as ei:
+            engine._serve(cq, xq, "rz", 0, budget)
+        assert ei.value.phase == "dispatch"
+        assert calls == []  # shed without touching the device
+
+
+class TestAdmissionControl:
+    def test_queue_flood_sheds_typed(self, artifact_path, serve_dirs):
+        """With the one in-flight slot stalled and the waiting room
+        sized 1: the first follow-up queues, every further request is
+        shed IMMEDIATELY with the typed QueueFullError, and the
+        stalled+queued requests complete once the stall releases —
+        overload degrades into fast rejections, never a hang."""
+        from smk_tpu.testing.faults import stall_predict
+
+        eng = _fresh_engine(
+            artifact_path, serve_dirs, max_queue=1, max_in_flight=1,
+        )
+        cq, xq = _queries(3)
+        results, errors = {}, {}
+
+        def call(name, **kw):
+            try:
+                results[name] = eng.predict(cq, xq, **kw)
+            except Exception as e:  # noqa: BLE001 - recorded
+                errors[name] = e
+
+        with stall_predict(max_fires=1, max_stall_s=10.0) as inj:
+            a = threading.Thread(target=call, args=("a",))
+            a.start()
+            for _ in range(200):  # wait until A is inside dispatch
+                if inj.fires:
+                    break
+                time.sleep(0.01)
+            assert inj.fires == 1
+            b = threading.Thread(
+                target=call, args=("b",),
+                kwargs={"deadline_s": 10.0},
+            )
+            b.start()
+            for _ in range(200):  # wait until B holds the queue slot
+                if eng._queue_sem._value == 0:
+                    break
+                time.sleep(0.01)
+            t0 = time.monotonic()
+            call("c")  # waiting room full -> immediate typed shed
+            shed_wall = time.monotonic() - t0
+        a.join(timeout=10.0)
+        b.join(timeout=10.0)
+        assert isinstance(errors["c"], QueueFullError)
+        assert shed_wall < 1.0
+        assert {"a", "b"} <= set(results)
+        assert eng.health()["requests_shed"] == 1
+        assert eng.health()["requests_served"] == 2
+
+
+class TestGracefulDegradation:
+    def test_partial_response_healthy_rows_bitwise(self, engine):
+        """Injected NaN rows come back as a typed PARTIAL response:
+        rows_degraded masks exactly the poisoned rows and every
+        healthy row is BIT-identical to the uninjected engine (the
+        PR 7 share-nothing invariant applied to serving)."""
+        from smk_tpu.testing.faults import inject_predict_nan
+
+        cq, xq = _queries(4, seed=33)
+        clean = engine.predict(cq, xq, seed=2)
+        assert not clean.rows_degraded.any()
+        with inject_predict_nan(rows=[1], max_fires=1) as inj:
+            hurt = engine.predict(cq, xq, seed=2)
+        assert inj.fires == 1
+        np.testing.assert_array_equal(
+            hurt.rows_degraded, [False, True, False, False]
+        )
+        assert hurt.degraded
+        healthy = [0, 2, 3]
+        np.testing.assert_array_equal(
+            hurt.p_quant[:, healthy], clean.p_quant[:, healthy]
+        )
+        # zero residue: the next request is clean
+        again = engine.predict(cq, xq, seed=2)
+        assert not again.rows_degraded.any()
+        np.testing.assert_array_equal(again.p_quant, clean.p_quant)
+
+    def test_health_state_transitions(self, artifact_path, serve_dirs):
+        """ready -> (threshold consecutive guard trips) -> degraded
+        -> (clean request) -> ready -> drain() -> draining with typed
+        rejection."""
+        from smk_tpu.testing.faults import inject_predict_nan
+
+        eng = _fresh_engine(
+            artifact_path, serve_dirs, degraded_threshold=2,
+        )
+        cq, xq = _queries(3)
+        assert eng.health()["state"] == "ready"
+        with inject_predict_nan(rows=[0], max_fires=2):
+            r1 = eng.predict(cq, xq)
+            assert r1.degraded
+            assert eng.health()["state"] == "ready"  # one trip
+            r2 = eng.predict(cq, xq)
+            assert r2.degraded
+        h = eng.health()
+        assert h["state"] == "degraded" and not h["ready"]
+        assert h["consecutive_guard_trips"] == 2
+        assert h["rows_degraded"] == 2
+        clean = eng.predict(cq, xq)
+        assert not clean.degraded
+        assert eng.health()["state"] == "ready"
+        eng.drain()
+        assert eng.health()["state"] == "draining"
+        with pytest.raises(EngineDrainingError):
+            eng.predict(cq, xq)
+
+
+class TestRequestSpans:
+    def test_span_tree(self, artifact_path, serve_dirs, tmp_path):
+        """Each request is a run-log span with nested bucket ->
+        dispatch/guard children — the PR 9 span-tree summarizer reads
+        serve logs unchanged."""
+        eng = _fresh_engine(
+            artifact_path, serve_dirs,
+            run_log_dir=str(tmp_path / "rlog"),
+        )
+        cq, xq = _queries(3)
+        eng.predict(cq, xq, request_id="req-test")
+        path = eng.run_log.path
+        eng.close()
+        from smk_tpu.obs.reporter import read_jsonl
+
+        recs = read_jsonl(path)
+        spans = [r for r in recs if r.get("kind") == "span"]
+        by_name = {}
+        for s in spans:
+            by_name.setdefault(s["name"], []).append(s)
+        req = [
+            s for s in by_name.get("request", [])
+            if s["attrs"].get("id") == "req-test"
+        ]
+        assert len(req) == 1
+        buckets = [
+            s for s in by_name.get("bucket", [])
+            if s["parent"] == req[0]["span_id"]
+        ]
+        assert len(buckets) == 1
+        children = {
+            s["name"] for s in spans
+            if s["parent"] == buckets[0]["span_id"]
+        }
+        assert children == {"dispatch", "guard"}
+        end = [r for r in recs if r.get("kind") == "run_end"]
+        assert end and end[0]["attrs"]["serve"]["state"] == "draining"
+
+
+@pytest.mark.slow  # 8-way concurrency soak — admission invariants under real thread contention (~10 s)
+class TestConcurrencySlow:
+    def test_eight_way_all_complete(self, artifact_path, serve_dirs):
+        eng = _fresh_engine(
+            artifact_path, serve_dirs, max_queue=64, max_in_flight=2,
+        )
+        cq, xq = _queries(4)
+        ref = eng.predict(cq, xq, seed=1)
+        out, errs = [], []
+
+        def worker():
+            try:
+                for _ in range(4):
+                    out.append(eng.predict(cq, xq, seed=1))
+            except Exception as e:  # noqa: BLE001 - recorded
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=worker) for _ in range(8)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=60.0)
+        assert not errs
+        assert len(out) == 32
+        for r in out:
+            np.testing.assert_array_equal(r.p_quant, ref.p_quant)
+        assert eng.health()["requests_served"] == 33

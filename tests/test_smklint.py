@@ -1113,10 +1113,94 @@ class TestAtomicWrite:
             ), rel
 
 
+class TestDeadlineDiscipline:
+    """SMK114 (ISSUE 14): request-path code in smk_tpu/serve/ may
+    reach a jit dispatch (the engine's _invoke_program seam) or a
+    raw device sync only from inside a function handed to
+    run_under_deadline / a watchdog's .run — a bare dispatch on the
+    caller thread reintroduces the unbounded hang the request
+    deadline exists to exclude."""
+
+    SERVE = "smk_tpu/serve/fixture.py"
+
+    def test_bare_dispatch_flagged(self):
+        src = (
+            "from smk_tpu.serve.engine import _invoke_program\n"
+            "def serve_one(prog, key, args):\n"
+            "    return _invoke_program(prog, key, *args)\n"
+        )
+        assert "SMK114" in rules_hit(src, path=self.SERVE)
+
+    def test_bare_device_sync_flagged(self):
+        src = (
+            "import jax\n"
+            "def fetch(x):\n"
+            "    return jax.device_get(x.block_until_ready())\n"
+        )
+        hits = rules_hit(src, path=self.SERVE)
+        assert hits.count("SMK114") == 2
+
+    def test_guarded_worker_passes(self):
+        src = (
+            "from smk_tpu.serve.deadline import run_under_deadline\n"
+            "from smk_tpu.serve.engine import _invoke_program\n"
+            "def serve_one(prog, key, args, budget):\n"
+            "    def worker():\n"
+            "        return _invoke_program(prog, key, *args)\n"
+            "    return run_under_deadline(worker, budget, "
+            "label='x')\n"
+        )
+        assert "SMK114" not in rules_hit(src, path=self.SERVE)
+
+    def test_guarded_lambda_and_watchdog_run_pass(self):
+        src = (
+            "from smk_tpu.serve.deadline import run_under_deadline\n"
+            "from smk_tpu.serve.engine import _invoke_program\n"
+            "def a(prog, key, budget):\n"
+            "    return run_under_deadline(\n"
+            "        lambda: _invoke_program(prog, key), budget,\n"
+            "        label='x')\n"
+            "def b(prog, key, watchdog):\n"
+            "    def worker():\n"
+            "        return _invoke_program(prog, key)\n"
+            "    return watchdog.run(worker)\n"
+        )
+        assert "SMK114" not in rules_hit(src, path=self.SERVE)
+
+    def test_outside_serve_not_in_scope(self):
+        src = (
+            "def f(prog, key):\n"
+            "    return _invoke_program(prog, key)\n"
+        )
+        assert "SMK114" not in rules_hit(src, path=OPS_PATH)
+        assert "SMK114" not in rules_hit(src, path=TESTS_PATH)
+
+    def test_suppression_with_justification(self):
+        src = (
+            "from smk_tpu.serve.engine import _invoke_program\n"
+            "def offline_export(prog, key):\n"
+            "    return _invoke_program(prog, key)  "
+            "# smklint: disable=SMK114 -- offline export path, "
+            "no caller to hang\n"
+        )
+        hits = rules_hit(src, path=self.SERVE)
+        assert "SMK114" not in hits and "SMK100" not in hits
+
+    def test_real_engine_clean_and_seeded_defect_caught(self):
+        real = "smk_tpu/serve/engine.py"
+        src = repo_file(real)
+        assert "SMK114" not in rules_hit(src, path=real)
+        broken = src + (
+            "\n\ndef _hot_path_escape(prog, key, args):\n"
+            "    return _invoke_program(prog, key, *args)\n"
+        )
+        assert "SMK114" in rules_hit(broken, path=real)
+
+
 @pytest.mark.parametrize("rule_id", [
     "SMK101", "SMK102", "SMK103", "SMK104", "SMK105", "SMK106",
     "SMK107", "SMK108", "SMK109", "SMK110", "SMK111", "SMK112",
-    "SMK113",
+    "SMK113", "SMK114",
 ])
 def test_every_rule_documented_in_catalogue(rule_id):
     from smk_tpu.analysis.lint import _list_rules
