@@ -115,6 +115,20 @@ BENCH_SERVE_BATCH / BENCH_SERVE_REQUESTS resize it
 stall→typed-timeout, flood→shed, NaN→bitwise-partial,
 fresh-process-zero-compile → SERVE_r15.jsonl).
 
+BENCH_RAGGED=1 appends the ISSUE 15 ragged-partition rung: a
+clustered binary field fit with partition_method="coherent" — the
+Morton split's unequal n_k padded onto the powers-of-√2 shape-bucket
+ladder (compile/buckets.py), one equal-m program set per OCCUPIED
+bucket — stamping sizes / occupied_buckets / pad_frac (the padding-
+overhead accounting), program_sources, and the convergence-adjusted
+ess_per_second (final-boundary streaming ESS totalled over subsets
+and bucket groups, per wall second — stamped on EVERY chunked rung,
+not just this one). BENCH_RAGGED_N / BENCH_RAGGED_K /
+BENCH_RAGGED_ITERS resize it (scripts/ragged_probe.py is the
+subprocess-isolated compile-accounting sibling → RAGGED_r16.jsonl:
+cold ≤ one program set per occupied bucket, warm-store fresh-process
+zero compiles, exact-rung-m bit-identity, padded-vs-trimmed parity).
+
 Synthetic latent surfaces use random Fourier features (an O(n)
 stationary GP approximation) so data generation never needs an n x n
 factorization.
@@ -152,13 +166,21 @@ from functools import partial as _partial
 
 
 @_partial(jax.jit, static_argnames=("n", "q", "p", "n_features"))
-def make_binary_field(key, n, q=1, p=2, phi=6.0, n_features=256):
+def make_binary_field(key, n, q=1, p=2, phi=6.0, n_features=256,
+                      coords=None):
     """Probit binary field with an RFF-approximated exponential GP.
 
     Jitted as one program — the ~15 eager dispatches cost ~30 s at
-    n=125k over the remote-tunnel backend (bench setup budget)."""
+    n=125k over the remote-tunnel backend (bench setup budget).
+    ``coords`` overrides the uniform location draw (the ragged rung's
+    clustered layout, ISSUE 15) — the latent field is then evaluated
+    at the supplied locations and every downstream draw is
+    unchanged-in-law."""
     kc, kw, kb, kcoef, kx, ky = jax.random.split(key, 6)
-    coords = jax.random.uniform(kc, (n, 2), jnp.float32)
+    if coords is None:
+        coords = jax.random.uniform(kc, (n, 2), jnp.float32)
+    else:
+        coords = jnp.asarray(coords, jnp.float32)
     # DELIBERATE misspecification, kept for ladder continuity
     # (ADVICE r5): per-axis independent Cauchy frequencies sample the
     # separable-product spectral measure, whose kernel is the
@@ -894,6 +916,13 @@ def run_rung_public(name, *, n, k, cov_model, n_samples, q=1, p=2,
     # model so rung health is visible without re-running
     record["live_rhat_final"] = agg["live_rhat_final"]
     record["live_ess_min_final"] = agg["live_ess_min_final"]
+    # ISSUE 15 (first nibble of ROADMAP item 3): the
+    # convergence-adjusted throughput — final-boundary total
+    # streaming ESS (summed over subsets, and over bucket groups on
+    # a ragged rung) per wall second, so a ladder speedup that
+    # degrades mixing cannot masquerade as a win. None when
+    # BENCH_LIVE_DIAG=0.
+    record["ess_per_second"] = agg["ess_per_second"]
     record["hbm_peak_bytes"] = agg["hbm_peak_bytes"]
     record["run_log"] = (
         pstats.run_log.path if pstats.run_log is not None else None
@@ -1036,6 +1065,9 @@ def run_rung_mesh_e2e(name, *, n, k, n_samples, cov_model="exponential",
         v = record["pipeline"].get(live_key)
         if v is not None and not math.isfinite(v):
             record["pipeline"][live_key] = None
+    # ISSUE 15: convergence-adjusted throughput, stamped top-level on
+    # every chunked rung (None when live diagnostics are off)
+    record["ess_per_second"] = agg["ess_per_second"]
     return record
 
 
@@ -1186,6 +1218,138 @@ def run_rung_serve_latency(name, *, solver_env=None, n=None, k=None,
         "program_sources": pstats.program_summary()[
             "program_sources"
         ],
+    }
+
+
+def run_rung_ragged(name, *, solver_env=None, n=None, k=None,
+                    n_samples=None, n_test=32):
+    """BENCH_RAGGED=1 (ISSUE 15): the ragged-partition ladder rung.
+
+    A CLUSTERED binary field (unequal-mass Gaussian blobs — the
+    real-world density raggedness coherent partitions exist for) is
+    fit through the PUBLIC pipeline with
+    ``partition_method="coherent"``: the Morton split produces
+    unequal n_k, subsets pad onto the √2 shape-bucket ladder
+    (compile/buckets.py), and the chunked executor runs one equal-m
+    program set per OCCUPIED bucket. The record stamps the ladder
+    accounting (sizes, occupied buckets, pad_frac — the padding-
+    overhead bound the README documents), program_sources, and the
+    convergence-adjusted ess_per_second so the bucket conversion's
+    speed is mixing-honest. BENCH_RAGGED_N / BENCH_RAGGED_K /
+    BENCH_RAGGED_ITERS resize; scripts/ragged_probe.py is the
+    subprocess-isolated compile-accounting sibling
+    (RAGGED_r16.jsonl)."""
+    import dataclasses
+
+    from smk_tpu.api import fit_meta_kriging
+    from smk_tpu.parallel.partition import coherent_partition
+    from smk_tpu.utils.tracing import ChunkPipelineStats
+
+    env = solver_env or {}
+    n = n or int(os.environ.get("BENCH_RAGGED_N", 2048))
+    k = k or int(os.environ.get("BENCH_RAGGED_K", 8))
+    n_samples = n_samples or int(
+        os.environ.get("BENCH_RAGGED_ITERS", 240)
+    )
+    rng = np.random.default_rng(17)
+    n_all = n + n_test
+    # blob count capped by the data budget (each blob needs its
+    # 16-row floor with room to spare), so the rebalance below can
+    # never need to push a count under the floor — at small
+    # BENCH_RAGGED_N/large K the old unconditional floor drove the
+    # last count negative and crashed the rung
+    n_blob = max(2, min(k // 2, n_all // 32))
+    weights = rng.dirichlet(np.full(n_blob, 0.8))
+    counts = np.maximum(16, (weights * n_all).astype(int))
+    # rebalance: trim any floor-induced overflow off the largest
+    # blobs (16 * n_blob <= n_all / 2, so this terminates above the
+    # floor), then pour the remainder into the last
+    while counts.sum() > n_all:
+        i = int(np.argmax(counts))
+        counts[i] -= min(counts[i] - 16, counts.sum() - n_all)
+    counts[-1] += n_all - counts.sum()
+    centers = rng.uniform(0.15, 0.85, size=(n_blob, 2))
+    blobs = np.concatenate([
+        rng.normal(c, 0.06, size=(int(cnt), 2))
+        for c, cnt in zip(centers, counts)
+    ])
+    rng.shuffle(blobs)
+    y, x, coords = make_binary_field(
+        jax.random.key(3), n_all,
+        coords=np.clip(blobs, 0.0, 1.0),
+    )
+    y, x, coords, coords_test, x_test = (
+        y[:n], x[:n], coords[:n], coords[n:], x[n:],
+    )
+    cfg = dataclasses.replace(
+        rung_config(
+            env, k=k, n_samples=n_samples,
+            cov_model="exponential", link="probit",
+        ),
+        partition_method="coherent",
+    )
+    # the partition the fit will build is a DETERMINISTIC function of
+    # the coordinates (coherent_partition ignores its key), so the
+    # ladder accounting can be stamped from an identical preview
+    part = coherent_partition(
+        jax.random.key(0), y, x, coords, k,
+        ladder=cfg.bucket_ladder,
+    )
+    pad = part.pad_summary()
+    pstats = ChunkPipelineStats()
+    # default chunk length: >= 4 sampling chunks, so the streaming
+    # batch-means ESS (one batch per chunk) exists by the final
+    # boundary and ess_per_second is a real number, not a
+    # too-few-batches NaN
+    kept = cfg.n_samples - cfg.n_burn_in
+    chunk_iters = int(
+        env.get("BENCH_CHUNK_ITERS", max(10, kept // 4))
+    )
+    t0 = time.time()
+    res = fit_meta_kriging(
+        jax.random.key(2), y, x, coords, coords_test, x_test,
+        config=cfg,
+        chunk_iters=chunk_iters,
+        pipeline_stats=pstats,
+    )
+    from smk_tpu.utils.tracing import device_sync
+
+    device_sync((res.param_grid, res.p_quant))
+    wall = time.time() - t0
+    agg = pstats.aggregate()
+    for live_key in ("live_rhat_final", "live_ess_min_final"):
+        v = agg[live_key]
+        agg[live_key] = (
+            v if v is not None and math.isfinite(v) else None
+        )
+    return {
+        "rung": name,
+        "n": n, "K": k, "iters": n_samples, "public_path": True,
+        "partition_method": "coherent",
+        "sizes": list(part.sizes),
+        "n_distinct_sizes": len(set(part.sizes)),
+        "ladder": list(part.ladder),
+        "occupied_buckets": list(part.buckets),
+        "pad_frac": pad["pad_frac"],
+        "pad_rows": pad["pad_rows"],
+        "wall_s_incl_compile": round(wall, 2),
+        "fit_s": round(
+            res.phase_seconds.get("subset_fits", 0.0), 2
+        ),
+        "ess_per_second": agg["ess_per_second"],
+        "live_rhat_final": agg["live_rhat_final"],
+        "live_ess_min_final": agg["live_ess_min_final"],
+        "ragged_groups": agg["ragged_groups"],
+        "finite": bool(
+            np.isfinite(np.asarray(res.p_quant)).all()
+            and np.isfinite(np.asarray(res.param_grid)).all()
+        ),
+        "program_sources": pstats.program_summary()[
+            "program_sources"
+        ],
+        "compile_store": cfg.compile_store_dir,
+        "chunk_pipeline": cfg.chunk_pipeline,
+        "fault_policy": cfg.fault_policy,
     }
 
 
@@ -2271,6 +2435,25 @@ def main():
         except Exception as e:
             reporter.ladder.append(
                 {"rung": "serve_latency", "error": repr(e)}
+            )
+            reporter.emit(partial=True)
+
+    # Ragged-partition rung (ISSUE 15): BENCH_RAGGED=1 appends the
+    # coherent-partition shape-bucket-ladder rung — unequal n_k
+    # padded onto the √2 ladder, one program set per occupied
+    # bucket, with the pad-waste accounting and the
+    # convergence-adjusted ess_per_second stamped
+    # (scripts/ragged_probe.py is the compile-accounting sibling
+    # emitting RAGGED_r16.jsonl). Reporter-first fallible like every
+    # probe cell.
+    if os.environ.get("BENCH_RAGGED", "0") == "1":
+        try:
+            reporter.add_rung(run_rung_ragged(
+                "ragged_coherent", solver_env=env,
+            ))
+        except Exception as e:
+            reporter.ladder.append(
+                {"rung": "ragged_coherent", "error": repr(e)}
             )
             reporter.emit(partial=True)
 

@@ -33,7 +33,13 @@ from smk_tpu.api import (
     prediction_factors,
     validate_query_batch,
 )
-from smk_tpu.parallel.partition import random_partition, Partition
+from smk_tpu.parallel.partition import (
+    PaddedPartition,
+    Partition,
+    coherent_partition,
+    padded_partition,
+    random_partition,
+)
 from smk_tpu.parallel.combine import (
     DomainSurvivalError,
     SubsetSurvivalError,
@@ -75,6 +81,9 @@ __all__ = [
     "validate_query_batch",
     "random_partition",
     "Partition",
+    "PaddedPartition",
+    "coherent_partition",
+    "padded_partition",
     "SubsetSurvivalError",
     "DomainSurvivalError",
     "ChunkTimeoutError",

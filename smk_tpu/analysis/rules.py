@@ -1,4 +1,4 @@
-"""smklint rules SMK101–SMK112 — the repo's JAX invariants, each one
+"""smklint rules SMK101–SMK115 — the repo's JAX invariants, each one
 traceable to the PR that established it (see analysis/RULES.md).
 
 All rules are pure-AST (no jax import). Shared machinery:
@@ -1739,6 +1739,99 @@ class DeadlineDisciplineRule(Rule):
             )
 
 
+# ---------------------------------------------------------------------------
+# SMK115 — ladder discipline (one shape-bucket arithmetic)
+# ---------------------------------------------------------------------------
+
+# The one sanctioned owner of padded-shape / bucket-size arithmetic
+# (ISSUE 15): the √2 ladder generator, smallest-fitting-bucket
+# selection, slice planning and pad accounting all live here and are
+# SHARED by the m-axis ragged partitions and the serve engine's
+# query-batch ladder.
+_BUCKETS_ZONE = "smk_tpu/compile/buckets"
+
+
+class LadderDisciplineRule(Rule):
+    id = "SMK115"
+    name = "ladder-discipline"
+    doc = (
+        "smk_tpu/ library code outside compile/buckets.py may not "
+        "compute padded shapes or bucket sizes itself — the enforced "
+        "signatures are the √2-rung arithmetic forms: a half-power "
+        "`base ** (x / 2)`, the `2 ** 0.5` constant, and `sqrt(2)` "
+        "calls (math/np/jnp or from-import spellings). "
+        "compile/buckets.bucket_ladder / bucket_for / select_bucket "
+        "/ slice_plan are the one source of truth: a second ladder "
+        "implementation that drifts by one rounding rule would "
+        "fragment the L1/L2 compile store into near-duplicate shape "
+        "buckets and silently undo the O(#buckets) compile "
+        "conversion (ISSUE 15)"
+    )
+
+    def applies(self, module):
+        norm = module.norm_path()
+        if _BUCKETS_ZONE in norm:
+            return False
+        return "smk_tpu/" in norm
+
+    def check(self, module, ctx):
+        # bare sqrt imported off math/numpy: `from math import sqrt`
+        # (aliased or not) — the same from-import coverage
+        # SMK110/111 grew
+        sqrt_aliases = {"sqrt"}
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ImportFrom) and node.level == 0:
+                for a in node.names:
+                    if a.name == "sqrt":
+                        sqrt_aliases.add(a.asname or a.name)
+        msg_rung = (
+            "half-power (√2-rung) arithmetic in library code — "
+            "bucket/padded-shape sizes come from "
+            "compile/buckets.bucket_ladder / bucket_for / "
+            "select_bucket, the one ladder the compile-store keys "
+            "are bucketed by (SMK115 ladder-discipline)"
+        )
+        msg_sqrt = (
+            "sqrt(2) ladder constant in library code — the √2 "
+            "bucket ladder lives in compile/buckets.py; import its "
+            "helpers instead of re-deriving rung math (SMK115 "
+            "ladder-discipline)"
+        )
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.BinOp) and isinstance(
+                node.op, ast.Pow
+            ):
+                r = node.right
+                if (
+                    isinstance(r, ast.BinOp)
+                    and isinstance(r.op, ast.Div)
+                    and isinstance(r.right, ast.Constant)
+                    and not isinstance(r.right.value, bool)
+                    and r.right.value in (2, 2.0)
+                ):
+                    yield self.finding(module, node, msg_rung)
+                elif (
+                    isinstance(r, ast.Constant)
+                    and r.value == 0.5
+                    and isinstance(node.left, ast.Constant)
+                    and not isinstance(node.left.value, bool)
+                    and node.left.value in (2, 2.0)
+                ):
+                    yield self.finding(module, node, msg_sqrt)
+            elif isinstance(node, ast.Call):
+                chain = attr_chain(node.func)
+                if (
+                    chain
+                    and chain[-1] in sqrt_aliases
+                    and len(node.args) == 1
+                    and not node.keywords
+                    and isinstance(node.args[0], ast.Constant)
+                    and not isinstance(node.args[0].value, bool)
+                    and node.args[0].value in (2, 2.0)
+                ):
+                    yield self.finding(module, node, msg_sqrt)
+
+
 ALL_RULES = [
     BatchingRuleRule(),
     HostNondeterminismRule(),
@@ -1754,4 +1847,5 @@ ALL_RULES = [
     MeshHygieneRule(),
     AtomicWriteRule(),
     DeadlineDisciplineRule(),
+    LadderDisciplineRule(),
 ]

@@ -37,7 +37,11 @@ from smk_tpu.parallel.executor import (
     fit_subsets_vmap,
     make_mesh,
 )
-from smk_tpu.parallel.partition import random_partition
+from smk_tpu.parallel.partition import (
+    PaddedPartition,
+    coherent_partition,
+    random_partition,
+)
 from smk_tpu.utils.tracing import PhaseTimes, device_sync, phase_timer
 
 
@@ -698,8 +702,22 @@ def _fit_meta_kriging_impl(
         )
 
     with phase_timer(times, "partition", log=run_log):
-        part = random_partition(k_part, y, x, coords, cfg.n_subsets)
-        device_sync(part.y)
+        # partition_method (ISSUE 15): "random" keeps the reference's
+        # equal-m padded split bit-identically; "coherent" is the
+        # Morton/Z-order spatial split — unequal n_k padded onto the
+        # shape-bucket ladder (a PaddedPartition the chunked
+        # executor's ragged driver fans out per occupied bucket)
+        if cfg.partition_method == "coherent":
+            part = coherent_partition(
+                k_part, y, x, coords, cfg.n_subsets,
+                ladder=cfg.bucket_ladder,
+            )
+            device_sync(part.groups[0].part.y)
+        else:
+            part = random_partition(
+                k_part, y, x, coords, cfg.n_subsets
+            )
+            device_sync(part.y)
 
     with phase_timer(times, "warm_start", log=run_log):
         y_long, x_long = stacked_design(y, x)
@@ -734,6 +752,10 @@ def _fit_meta_kriging_impl(
             # the chunked executor, which consults the store before
             # tracing (ISSUE 8) — enabling it implies chunking too
             or cfg.compile_store_dir is not None
+            # ragged partitions fan out per bucket group inside the
+            # chunked executor (ISSUE 15) — a PaddedPartition implies
+            # chunking exactly as the store/quarantine knobs do
+            or isinstance(part, PaddedPartition)
         ):
             from smk_tpu.parallel.recovery import fit_subsets_chunked
 

@@ -20,6 +20,15 @@ coarsest-cost first:
 see the README's "AOT & compile caching" section.
 """
 
+from smk_tpu.compile.buckets import (
+    MIN_BUCKET,
+    bucket_for,
+    bucket_ladder,
+    pad_accounting,
+    select_bucket,
+    slice_plan,
+    validate_ladder,
+)
 from smk_tpu.compile.programs import (
     L1_CACHE_MAX,
     aux_bucket_key,
@@ -44,6 +53,13 @@ from smk_tpu.compile.xla_cache import (
 )
 
 __all__ = [
+    "MIN_BUCKET",
+    "bucket_for",
+    "bucket_ladder",
+    "pad_accounting",
+    "select_bucket",
+    "slice_plan",
+    "validate_ladder",
     "L1_CACHE_MAX",
     "aux_bucket_key",
     "chunk_bucket_key",

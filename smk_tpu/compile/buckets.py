@@ -1,0 +1,183 @@
+"""Shape-bucket ladder math — the ONE owner of padded-shape and
+bucket-size computation (ISSUE 15, smklint SMK115).
+
+Ragged workloads hit the compile stack on two axes:
+
+- the **m axis** (subset size): real-world / spatially-coherent
+  partitions (``parallel/partition.coherent_partition``) produce
+  unequal per-subset row counts ``n_k``, and every DISTINCT m traces
+  its own chunk/stats/finalize/refork program set — an
+  O(#distinct-m) compile tax the L1/L2 store cannot amortize;
+- the **query axis** (serving): request batches arrive at arbitrary
+  sizes (``serve/engine.py``).
+
+The answer to both is the same: round sizes UP onto a fixed ladder of
+buckets so at most O(#buckets) program sets ever exist, padding the
+gap with rows that are arithmetically invisible (the m-axis pad-row
+identity — mask 0, index -1, far-away pseudo-coordinates — lives in
+``parallel/partition.py``; the query-axis repeat-first-row pad lives
+in the engine; THIS module owns the size arithmetic they both key
+off).
+
+The m-axis ladder uses powers of √2 (``bucket_ladder``): consecutive
+rungs differ by ~41% (integer rounding stretches the worst small-rung
+gap to 16/11 ≈ 1.46), so the padded-row overhead of any subset is
+bounded by ``rung/previous_rung - 1`` ≤ ~0.46 of its real rows (and
+averages far less), while the whole [min_bucket, max] range needs
+only ``2·log2(max/min)`` buckets. A size that already IS a rung takes
+the exact-size bucket — zero pad rows, and (because the executor's
+bucket keys are pure shape functions) byte-identical L1/L2 program
+keys to an equal-m fit of that size.
+
+smklint **SMK115** (ladder-discipline) enforces the ownership: the
+√2-rung arithmetic (``base ** (i / 2)`` forms, ``sqrt(2)``
+constants) appearing in smk_tpu/ library code outside this module is
+a finding — a second ladder implementation that drifts by one
+rounding rule would silently fragment the compile store.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Sequence, Tuple
+
+# The default smallest m-axis bucket: tiny subsets pad up to at least
+# this many rows. Dense-path subsets below ~8 rows are degenerate for
+# kriging anyway, and a floor keeps the ladder finite at the bottom.
+MIN_BUCKET = 8
+
+
+def bucket_ladder(
+    max_size: int, *, min_bucket: int = MIN_BUCKET
+) -> Tuple[int, ...]:
+    """Ascending powers-of-√2 rungs covering ``[min_bucket,
+    max_size]``: ``round(2 ** (i / 2))`` for integer i, deduplicated
+    and strictly increasing, extended until one rung holds
+    ``max_size``. Integer sizes that are exact rungs (8, 11, 16, 23,
+    32, 45, 64, 91, 128, ...) map to themselves under
+    :func:`bucket_for` — the exact-m bucket contract."""
+    if max_size < 1:
+        raise ValueError(f"max_size must be >= 1, got {max_size}")
+    if min_bucket < 1:
+        raise ValueError(f"min_bucket must be >= 1, got {min_bucket}")
+    rungs: List[int] = []
+    i = max(0, math.ceil(2 * math.log2(min_bucket)) - 1)
+    while True:
+        r = int(round(2 ** (i / 2)))
+        if r >= min_bucket and (not rungs or r > rungs[-1]):
+            rungs.append(r)
+            if r >= max_size:
+                break
+        i += 1
+    return tuple(rungs)
+
+
+def select_bucket(n: int, buckets: Sequence[int]) -> int:
+    """The smallest bucket that holds ``n`` rows — or the LARGEST
+    bucket when none does (the serve engine's ladder-cap semantics:
+    an oversized request is split into max-bucket slices first, so
+    the overflow case only ever sees n <= max(buckets); the m-axis
+    partition path uses :func:`bucket_for`, which refuses overflow
+    instead). ``buckets`` must be ascending (the engine sorts at
+    construction; :func:`bucket_ladder` emits ascending)."""
+    for b in buckets:
+        if b >= n:
+            return int(b)
+    return int(buckets[-1])
+
+
+def bucket_for(n: int, ladder: Sequence[int]) -> int:
+    """The smallest ladder rung holding ``n`` rows; a typed error if
+    the ladder tops out below ``n`` (a partition must never silently
+    truncate a subset to fit a bucket)."""
+    if n < 1:
+        raise ValueError(f"subset size must be >= 1, got {n}")
+    for b in ladder:
+        if b >= n:
+            return int(b)
+    raise ValueError(
+        f"no ladder rung holds {n} rows (ladder max "
+        f"{int(ladder[-1])}) — extend bucket_ladder / "
+        "config.bucket_ladder to cover the largest subset"
+    )
+
+
+def slice_plan(
+    n: int, buckets: Sequence[int]
+) -> List[Tuple[int, int, int]]:
+    """Micro-batch plan of one ``n``-row request over an ascending
+    bucket ladder: ``[(start, stop, bucket), ...]`` — slices of at
+    most ``max(buckets)`` rows, each padded up to the smallest bucket
+    that holds it. This IS the serve engine's historical dispatch
+    loop (``for lo in range(0, n, cap)`` + smallest-fitting-bucket),
+    hoisted here so fit and serve share one selection/padding
+    arithmetic (regression-pinned byte-identical in
+    tests/test_ragged.py)."""
+    cap = int(buckets[-1])
+    return [
+        (lo, min(lo + cap, n), select_bucket(min(lo + cap, n) - lo, buckets))
+        for lo in range(0, n, cap)
+    ]
+
+
+def validate_ladder(ladder) -> Tuple[int, ...]:
+    """Normalize + validate an explicit ladder (``SMKConfig.
+    bucket_ladder``, the R front-end's ``bucket.ladder``): positive
+    ints, strictly ascending; a bare scalar is a one-rung ladder
+    (reticulate ships a length-1 R integer vector as a Python
+    scalar). Returns it as a tuple."""
+    if isinstance(ladder, (int, float)) and not isinstance(
+        ladder, bool
+    ):
+        ladder = (ladder,)
+    if isinstance(ladder, (str, bytes)):
+        raise ValueError(
+            "bucket ladder must be a sequence of ascending positive "
+            f"ints (or one int), got {ladder!r}"
+        )
+    try:
+        out = tuple(int(b) for b in ladder)
+    except (TypeError, ValueError) as e:
+        raise ValueError(
+            "bucket ladder must be a sequence of ascending positive "
+            f"ints (or one int), got {ladder!r}"
+        ) from e
+    if not out:
+        raise ValueError("bucket ladder must not be empty")
+    if any(b < 1 for b in out):
+        raise ValueError(f"bucket ladder entries must be >= 1: {out}")
+    if any(b2 <= b1 for b1, b2 in zip(out, out[1:])):
+        raise ValueError(
+            f"bucket ladder must be strictly ascending: {out}"
+        )
+    return out
+
+
+def pad_accounting(
+    sizes: Sequence[int], buckets: Sequence[int]
+) -> Dict[str, object]:
+    """Padding overhead of a ragged partition: ``sizes[k]`` real rows
+    padded to ``buckets[k]`` rows (per-subset, parallel lists). The
+    returned ``pad_frac`` — pad rows over padded rows — is the
+    figure the bench/probe records report and the README's overhead
+    bound speaks to (≤ ~0.32 for a √2 ladder at min_bucket-sized or
+    larger subsets: a subset just past a rung pads by at most the
+    worst integer-rounded rung gap of ~46%, i.e. ≤ 0.46/1.46 of its
+    padded rows)."""
+    if len(sizes) != len(buckets):
+        raise ValueError(
+            f"{len(sizes)} sizes vs {len(buckets)} buckets"
+        )
+    real = int(sum(int(s) for s in sizes))
+    padded = int(sum(int(b) for b in buckets))
+    if any(s > b for s, b in zip(sizes, buckets)):
+        raise ValueError("a subset exceeds its bucket")
+    return {
+        "real_rows": real,
+        "padded_rows": padded,
+        "pad_rows": padded - real,
+        "pad_frac": (
+            round((padded - real) / padded, 6) if padded else 0.0
+        ),
+        "occupied_buckets": sorted({int(b) for b in buckets}),
+    }

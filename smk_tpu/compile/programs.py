@@ -95,6 +95,13 @@ _DIGEST_NEUTRAL = dict(
     # coordination — a store built under one deadline must serve
     # runs under any other
     ckpt_commit_timeout_s=120.0,
+    # partition layout knobs (ISSUE 15): they change WHICH rows land
+    # in which subset (covered by the run-identity data fingerprints)
+    # and which shape buckets get occupied (covered by the m/k bucket
+    # key fields) — never the program traced at a given shape, so one
+    # store serves random and coherent partitions alike
+    partition_method="random",
+    bucket_ladder=None,
 )
 
 

@@ -180,7 +180,10 @@ def precompile(
     dispatch.
 
     ``part``/``coords_test``/``x_test`` carry the shapes (arrays or
-    ``ShapeDtypeStruct``). ``store_dir`` overrides
+    ``ShapeDtypeStruct``). A ragged
+    :class:`~smk_tpu.parallel.partition.PaddedPartition` precompiles
+    one program set per occupied bucket group (ISSUE 15) and merges
+    the per-group reports. ``store_dir`` overrides
     ``model.config.compile_store_dir`` (either enables L2; with
     neither, programs still land in the model's L1 cache, warming
     this process only). Returns a report: per-program source
@@ -213,6 +216,36 @@ def precompile(
         stacked_subset_data,
         subset_chain_keys,
     )
+
+    from smk_tpu.parallel.partition import PaddedPartition
+
+    if isinstance(part, PaddedPartition):
+        # ragged partition (ISSUE 15): one ordinary precompile per
+        # OCCUPIED bucket group — exactly the program sets the
+        # ragged driver (parallel/recovery._fit_ragged_chunked)
+        # resolves, so a store warmed here serves a ragged fit with
+        # zero backend compiles
+        t0r = monotonic()
+        sub = [
+            precompile(
+                model, g.part, coords_test, x_test,
+                chunk_iters=chunk_iters, chunk_size=chunk_size,
+                store_dir=store_dir, stats=stats, mesh=mesh,
+                mesh_spec=mesh_spec, allow_topology=allow_topology,
+            )
+            for g in part.groups
+        ]
+        return {
+            "store_dir": sub[0]["store_dir"],
+            "n_programs": sum(r["n_programs"] for r in sub),
+            "programs": [p for r in sub for p in r["programs"]],
+            "compile_s": round(monotonic() - t0r, 4),
+            "topology": sub[0]["topology"],
+            "buckets": [
+                {"bucket": int(g.bucket), "n_subsets": len(g.subset_ids)}
+                for g in part.groups
+            ],
+        }
 
     cfg = model.config
     t0 = monotonic()

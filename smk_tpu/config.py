@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 
 COV_MODELS = ("exponential", "matern32", "matern52")
+PARTITION_METHODS = ("random", "coherent")
 LINKS = ("probit", "logit")
 COMBINERS = ("wasserstein_mean", "weiszfeld_median")
 PHI_PROPOSAL_FAMILIES = ("gaussian", "student_t", "mixture")
@@ -87,6 +88,32 @@ class SMKConfig:
 
     # Partition (R:15-18): K subsets, floor(n/K) each, remainder padded.
     n_subsets: int = 20
+
+    # How rows are assigned to the K subsets (ISSUE 15):
+    # - "random" (default): the reference's uniform random disjoint
+    #   split (parallel/partition.random_partition) — equal-m padded
+    #   stacks, bit-identical to every prior round.
+    # - "coherent": spatially-coherent Morton/Z-order split
+    #   (parallel/partition.coherent_partition) — each subset is a
+    #   compact spatial neighborhood (measured: better spatial-decay
+    #   recovery than random; see the README's accuracy-honesty
+    #   note), which produces UNEQUAL subset sizes;
+    #   subsets pad up to a powers-of-√2 shape-bucket ladder
+    #   (compile/buckets.py) and the fit runs one equal-m program set
+    #   per OCCUPIED bucket (at most O(#buckets) compiles, warm-store
+    #   zero-compile — parallel/recovery._fit_ragged_chunked).
+    #   Implies chunked execution (the bucket-group driver lives in
+    #   the chunked executor). Both knobs are normalized out of the
+    #   compile digest and checkpoint run-identity CONFIG repr — the
+    #   partition changes the data slices, which the identity's data
+    #   fingerprints already cover, and never changes a compiled
+    #   program at equal shapes.
+    partition_method: str = "random"
+    # Explicit m-axis bucket ladder (ascending positive ints) for
+    # ragged partitions; None = the √2 ladder covering the largest
+    # subset (compile/buckets.bucket_ladder). A ladder topping out
+    # below the largest subset is a typed error at partition time.
+    bucket_ladder: tuple = None
 
     # MCMC budget (R:57-59, :85): n_samples total, burn-in fraction.
     n_samples: int = 5000
@@ -619,6 +646,19 @@ class SMKConfig:
             )
         if self.cov_model not in COV_MODELS:
             raise ValueError(f"cov_model must be one of {COV_MODELS}")
+        if self.partition_method not in PARTITION_METHODS:
+            raise ValueError(
+                f"partition_method must be one of {PARTITION_METHODS}"
+            )
+        if self.bucket_ladder is not None:
+            from smk_tpu.compile.buckets import validate_ladder
+
+            # normalize to a tuple so the frozen repr (and with it
+            # the run-identity/compile digests) is list/tuple-stable
+            object.__setattr__(
+                self, "bucket_ladder",
+                validate_ladder(self.bucket_ladder),
+            )
         if self.link not in LINKS:
             raise ValueError(f"link must be one of {LINKS}")
         if self.combiner not in COMBINERS:

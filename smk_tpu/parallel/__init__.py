@@ -2,7 +2,16 @@
 (reference L4 — PSOCK cluster + foreach, here vmap/shard_map over a
 device mesh) and posterior combiners (reference L5)."""
 
-from smk_tpu.parallel.partition import random_partition, Partition
+from smk_tpu.parallel.partition import (
+    BucketGroup,
+    PaddedPartition,
+    Partition,
+    coherent_assignments,
+    coherent_partition,
+    padded_partition,
+    partition_from_indices,
+    random_partition,
+)
 from smk_tpu.parallel.executor import (
     fit_subsets_vmap,
     fit_subsets_sharded,
@@ -32,6 +41,12 @@ from smk_tpu.parallel.recovery import (
 __all__ = [
     "random_partition",
     "Partition",
+    "BucketGroup",
+    "PaddedPartition",
+    "coherent_assignments",
+    "coherent_partition",
+    "padded_partition",
+    "partition_from_indices",
     "fit_subsets_vmap",
     "fit_subsets_sharded",
     "fit_subsets_checkpointed",

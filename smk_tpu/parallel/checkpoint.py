@@ -396,6 +396,12 @@ def identity_config_repr(cfg) -> bytes:
         # checkpoint written under one deadline must resume under
         # another
         ckpt_commit_timeout_s=120.0,
+        # partition layout knobs (ISSUE 15): the data fingerprints
+        # already cover the actual row assignment — normalizing the
+        # CONFIG fields keeps a group checkpoint resumable by any
+        # entry path that reconstructs the same padded data/keys
+        partition_method="random",
+        bucket_ladder=None,
     )
     return repr(cfg_ident).encode()
 

@@ -1,4 +1,8 @@
-"""Random disjoint partitioner — reference layer L2.
+"""Partitioners — reference layer L2: the random equal-m split, and
+the ragged shape-bucket machinery (ISSUE 15: PaddedPartition /
+coherent Morton partitioner — unequal subset sizes padded onto the
+compile/buckets.py √2 ladder, one equal-m bucket group per occupied
+rung).
 
 The reference partitions by a sequential sampling-without-replacement
 loop with an O(K n log n) setdiff shrink
@@ -19,10 +23,18 @@ jax.random key.
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple
+from typing import NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+from smk_tpu.compile.buckets import (
+    bucket_for,
+    bucket_ladder,
+    pad_accounting,
+    validate_ladder,
+)
 
 
 class Partition(NamedTuple):
@@ -76,6 +88,27 @@ def random_partition(
         [perm, jnp.full((total - n,), -1, dtype=perm.dtype)]
     )
     index = padded.reshape(k, m)
+    return _apply_pad_identity(y, x, coords, index)
+
+
+def _apply_pad_identity(
+    y: jnp.ndarray,
+    x: jnp.ndarray,
+    coords: jnp.ndarray,
+    index: jnp.ndarray,
+) -> Partition:
+    """Gather a (K, m) row-index layout into a stacked
+    :class:`Partition`, applying the ONE pad-row identity every
+    consumer of padded subsets shares (the fused build kernels, the
+    sampler's mask weighting, and — since ISSUE 15 — the ragged
+    bucket groups): pad rows carry ``index`` -1, ``mask`` 0 (zero
+    likelihood weight), zeroed y/x, and distinct far-away
+    pseudo-coordinates so subset correlation matrices never contain
+    duplicate points. Index -1 marks a pad row; real entries gather
+    their data rows. This is exactly the tail-padding arithmetic
+    :func:`random_partition` has always traced (hoisted, not changed
+    — equal-m partitions stay bit-identical)."""
+    k, m = index.shape
     mask = (index >= 0).astype(coords.dtype)
     safe = jnp.maximum(index, 0)
 
@@ -98,3 +131,288 @@ def random_partition(
     coords_p = jnp.where(mask[..., None] > 0, coords_p, pad_coords)
 
     return Partition(y=y_p, x=x_p, coords=coords_p, mask=mask, index=index)
+
+
+@jax.jit
+def partition_from_indices(
+    y: jnp.ndarray,
+    x: jnp.ndarray,
+    coords: jnp.ndarray,
+    index: jnp.ndarray,
+) -> Partition:
+    """Public jitted spelling of the shared pad-identity gather: a
+    (K, m) row-index array (-1 = pad) into a stacked
+    :class:`Partition` — the constructor the ragged bucket groups and
+    the probe/tests use to build partitions from explicit
+    assignments."""
+    return _apply_pad_identity(y, x, coords, index)
+
+
+class BucketGroup(NamedTuple):
+    """One occupied bucket of a ragged partition: the subsets whose
+    padded size is ``bucket``, stacked as an ordinary equal-m
+    :class:`Partition` (every downstream consumer — executor,
+    sampler, checkpoint, quarantine — sees a plain Partition and
+    needs no ragged awareness beyond the driver loop)."""
+
+    bucket: int
+    subset_ids: Tuple[int, ...]  # original subset index per row
+    part: Partition
+
+
+class PaddedPartition(NamedTuple):
+    """A ragged K-subset partition padded onto a shape-bucket ladder
+    (ISSUE 15): unequal true sizes ``sizes[k]``, each subset padded
+    up to the smallest ladder rung that holds it
+    (compile/buckets.bucket_for) with the shared pad-row identity,
+    and subsets grouped by bucket into equal-m :class:`BucketGroup`
+    stacks (ascending bucket order; original subset order preserved
+    within a group). A fit compiles at most one program set per
+    OCCUPIED bucket instead of one per distinct size — the
+    O(#distinct-m) → O(#buckets) compile conversion."""
+
+    groups: Tuple[BucketGroup, ...]
+    sizes: Tuple[int, ...]  # true n_k per original subset
+    ladder: Tuple[int, ...]
+
+    @property
+    def n_subsets(self) -> int:
+        return len(self.sizes)
+
+    @property
+    def buckets(self) -> Tuple[int, ...]:
+        """Occupied buckets, ascending."""
+        return tuple(g.bucket for g in self.groups)
+
+    @property
+    def bucket_of_subset(self) -> Tuple[int, ...]:
+        """Padded size per ORIGINAL subset index."""
+        out = [0] * self.n_subsets
+        for g in self.groups:
+            for j in g.subset_ids:
+                out[j] = g.bucket
+        return tuple(out)
+
+    def pad_summary(self) -> dict:
+        """compile/buckets.pad_accounting over the whole partition —
+        the pad-waste record the bench/probe stamps."""
+        return pad_accounting(self.sizes, self.bucket_of_subset)
+
+
+def padded_partition(
+    y: jnp.ndarray,
+    x: jnp.ndarray,
+    coords: jnp.ndarray,
+    assignments: Sequence[np.ndarray],
+    *,
+    ladder: Optional[Sequence[int]] = None,
+) -> PaddedPartition:
+    """Build a :class:`PaddedPartition` from explicit per-subset row
+    assignments (a sequence of disjoint 1-D row-index arrays of
+    UNEQUAL lengths — a coherent partitioner's output, or any
+    external split).
+
+    Each subset pads up to ``bucket_for(n_k, ladder)`` with the pad
+    identity of :func:`_apply_pad_identity` (mask 0, index -1,
+    far-line pseudo-coordinates — FINITE pad-row content is provably
+    erased: two datasets differing only in values at rows no subset
+    references produce bit-identical partitions, because pads gather
+    then zero by the mask; the multiplicative zeroing is exactly
+    random_partition's historical tail arithmetic, so non-finite
+    DATA remains the executor guard's concern, not padding's). ``ladder`` defaults to the √2 ladder covering the
+    largest subset (compile/buckets.bucket_ladder); an explicit
+    ladder (SMKConfig.bucket_ladder) that tops out below the largest
+    subset is a typed error, never a truncation."""
+    sizes = tuple(int(np.asarray(a).shape[0]) for a in assignments)
+    if not sizes:
+        raise ValueError("assignments must name at least one subset")
+    if any(s < 1 for s in sizes):
+        raise ValueError(
+            f"every subset needs at least one row, got sizes {sizes}"
+        )
+    # typed validation BEFORE the jitted gather: an out-of-range
+    # index would be silently clamped by XLA (duplicating the last
+    # row) and a negative real index silently becomes a pad row —
+    # both produce a wrong fit with no error (e.g. 1-based indices
+    # from the R side). Same typed-rejection-at-the-boundary policy
+    # as api.validate_query_batch / bucket_for.
+    n_rows = int(np.asarray(y).shape[0])
+    flat = np.concatenate(
+        [np.asarray(a).reshape(-1) for a in assignments]
+    )
+    if not np.issubdtype(flat.dtype, np.integer):
+        raise ValueError(
+            "assignments must be integer row indices, got dtype "
+            f"{flat.dtype}"
+        )
+    if flat.size and (flat.min() < 0 or flat.max() >= n_rows):
+        bad = flat[(flat < 0) | (flat >= n_rows)][:8]
+        raise ValueError(
+            f"assignment row indices must lie in [0, n={n_rows}); "
+            f"got {bad.tolist()} — 1-based or negative indices "
+            "would be silently clamped/dropped by the padded gather"
+        )
+    if np.unique(flat).size != flat.size:
+        dup = flat[np.bincount(flat, minlength=n_rows)[flat] > 1][:8]
+        raise ValueError(
+            "assignments must be DISJOINT subsets — row indices "
+            f"{sorted(set(dup.tolist()))} appear in more than one "
+            "subset (or twice in one)"
+        )
+    if ladder is None:
+        lad = bucket_ladder(max(sizes))
+    else:
+        lad = validate_ladder(ladder)
+    buckets = [bucket_for(s, lad) for s in sizes]
+    by_bucket: dict = {}
+    for j, b in enumerate(buckets):
+        by_bucket.setdefault(b, []).append(j)
+    groups = []
+    for b in sorted(by_bucket):
+        ids = by_bucket[b]
+        index = np.full((len(ids), b), -1, np.int32)
+        for row, j in enumerate(ids):
+            a = np.asarray(assignments[j], np.int32).reshape(-1)
+            index[row, : a.shape[0]] = a
+        part = partition_from_indices(
+            y, x, coords, jnp.asarray(index)
+        )
+        groups.append(
+            BucketGroup(
+                bucket=int(b), subset_ids=tuple(ids), part=part
+            )
+        )
+    return PaddedPartition(
+        groups=tuple(groups), sizes=sizes, ladder=lad
+    )
+
+
+def coherent_assignments(
+    coords,
+    n_subsets: int,
+    *,
+    cell_bits: Optional[int] = None,
+) -> list:
+    """Spatially-coherent subset assignments by Morton (Z-order)
+    curve: rows are sorted by interleaved-bit codes of their
+    quantized coordinates and cut into ``n_subsets`` contiguous runs,
+    with each cut SNAPPED to the nearest coarse-cell boundary (points
+    sharing the top ``cell_bits`` bits per dimension stay together) —
+    which is what makes the resulting sizes n_k genuinely UNEQUAL:
+    spatial cells don't divide evenly. Deterministic (no PRNG — the
+    split is a pure function of the coordinates), host-side numpy (a
+    one-time O(n log n) sort at partition time, the same cost class
+    as the reference's setdiff loop it replaces).
+
+    Spatial coherence gives each subset a compact neighborhood, so
+    its correlation matrix carries dense short-range structure
+    instead of the near-diagonal pattern a uniform random scatter of
+    a large domain produces — measured (tests/test_ragged.py
+    accuracy smoke vs random_partition): better recovery of the
+    spatial decay phi on a short-range field, while GLOBAL-anchor
+    prediction under the unweighted quantile-averaging combine can
+    favor random at small K (a coherent subset extrapolates outside
+    its own cell; per-anchor combine weighting is the open
+    follow-up).
+
+    A cut whose nearest cell boundary is farther than a QUARTER of an
+    ideal subset away falls back to the raw equal split point (one
+    oversized cell must not swallow a neighbor subset). The quarter
+    clamp is what makes the imbalance bound real: two adjacent cuts
+    can each move at most ideal/4 toward each other, so every n_k
+    lies within ±50% of n/K (up to the ±1 of integer targets)."""
+    c = np.asarray(coords, np.float64)
+    if c.ndim != 2:
+        raise ValueError(
+            f"coords must be (n, d), got shape {c.shape}"
+        )
+    n, d = c.shape
+    k = int(n_subsets)
+    if k < 1 or k > n:
+        raise ValueError(
+            f"n_subsets must be in [1, n={n}], got {k}"
+        )
+    bits = 16
+    lo = c.min(axis=0)
+    span = c.max(axis=0) - lo
+    span = np.where(span > 0, span, 1.0)
+    quant = np.minimum(
+        ((c - lo) / span * (2**bits - 1)).astype(np.uint64),
+        2**bits - 1,
+    )
+    code = np.zeros(n, np.uint64)
+    for b in range(bits):
+        for j in range(d):
+            code |= ((quant[:, j] >> np.uint64(b)) & np.uint64(1)) << (
+                np.uint64(b * d + j)
+            )
+    order = np.argsort(code, kind="stable")
+    if k == 1:
+        return [order]
+    if cell_bits is None:
+        # coarse cells a few levels finer than the subset count: each
+        # subset spans several cells, so snapping moves cuts by a
+        # cell, not a subset
+        cell_bits = max(1, int(np.ceil(np.log2(max(k, 2)) / d)) + 2)
+    cell_bits = min(cell_bits, bits)
+    coarse = code[order] >> np.uint64(d * (bits - cell_bits))
+    # indices where a new coarse cell starts (valid cut points)
+    changes = np.flatnonzero(coarse[1:] != coarse[:-1]) + 1
+    cuts = []
+    ideal = n / k
+    for i in range(1, k):
+        target = int(round(i * ideal))
+        if changes.size:
+            pos = np.searchsorted(changes, target)
+            cands = [
+                int(changes[j])
+                for j in (pos - 1, pos)
+                if 0 <= j < changes.size
+            ]
+            best = min(cands, key=lambda cx: abs(cx - target))
+            # clamp the snap to ideal/4: two ADJACENT cuts each
+            # moving ideal/2 toward each other could crush a subset
+            # to a single row (measured in review on 3-cluster
+            # data); a quarter-window keeps every size within the
+            # documented ±50% of n/K while still honoring most cell
+            # boundaries
+            if abs(best - target) > ideal / 4:
+                best = target  # oversized cell: split it
+        else:
+            best = target
+        cuts.append(best)
+    # enforce strictly increasing, non-empty subsets
+    fixed = []
+    prev = 0
+    for i, cpos in enumerate(cuts):
+        lo_b = prev + 1
+        hi_b = n - (k - 1 - i)
+        fixed.append(min(max(cpos, lo_b), hi_b))
+        prev = fixed[-1]
+    return np.split(order, fixed)
+
+
+def coherent_partition(
+    key: jax.Array,
+    y: jnp.ndarray,
+    x: jnp.ndarray,
+    coords: jnp.ndarray,
+    n_subsets: int,
+    *,
+    ladder: Optional[Sequence[int]] = None,
+) -> PaddedPartition:
+    """Spatially-coherent disjoint split of (y, x, coords) into K
+    bucket-padded subsets — the ragged counterpart of
+    :func:`random_partition` (same argument order; ``key`` is
+    accepted for signature symmetry and ignored: the Morton split is
+    a deterministic function of the coordinates, which is exactly
+    what makes a coherent fit reproducible and its compile-store
+    bucket population stable across runs). Returns a
+    :class:`PaddedPartition`; ``ladder`` defaults to the √2 bucket
+    ladder covering the largest subset."""
+    del key  # deterministic by design (see docstring)
+    return padded_partition(
+        y, x, coords,
+        coherent_assignments(coords, n_subsets),
+        ladder=ladder,
+    )

@@ -52,7 +52,7 @@ from smk_tpu.parallel.executor import (
     subset_chain_keys,
     subset_runner,
 )
-from smk_tpu.parallel.partition import Partition
+from smk_tpu.parallel.partition import PaddedPartition, Partition
 from smk_tpu.utils.checkpoint import (
     BackgroundWriter,
     is_key_leaf,
@@ -970,9 +970,21 @@ def fit_subsets_chunked(
     nan_guard: bool = False,
     pipeline_stats: Optional[ChunkPipelineStats] = None,
     domain_map: Optional[FailureDomainMap] = None,
+    subset_keys=None,
 ) -> Optional[SubsetResult]:
     """Run-log arming wrapper over :func:`_fit_subsets_chunked_impl`
     (which carries the full executor docstring).
+
+    A :class:`~smk_tpu.parallel.partition.PaddedPartition` (ragged
+    subsets padded onto the shape-bucket ladder, ISSUE 15) routes
+    through :func:`_fit_ragged_chunked`: one ordinary equal-m group
+    fit per OCCUPIED bucket, stitched back into original subset
+    order — so a ragged fit resolves every program through the same
+    L1/L2 bucket keys and compiles at most one program set per
+    bucket. ``subset_keys`` (internal, the ragged driver's seam)
+    overrides the per-subset PRNG keys so a subset's chain depends
+    only on its GLOBAL index, never on which bucket group it landed
+    in.
 
     Observability plumbing (ISSUE 10): when the caller's
     ``pipeline_stats`` already carries a run log (api.fit_meta_kriging
@@ -983,6 +995,14 @@ def fit_subsets_chunked(
     standalone executor run (bench.py's public rungs) gets a complete
     timeline too."""
     cfg = model.config
+    if isinstance(part, PaddedPartition):
+        return _fit_ragged_chunked(
+            model, part, coords_test, x_test, key, beta_init,
+            chunk_iters=chunk_iters, checkpoint_path=checkpoint_path,
+            mesh=mesh, chunk_size=chunk_size, progress=progress,
+            stop_after_chunks=stop_after_chunks, nan_guard=nan_guard,
+            pipeline_stats=pipeline_stats, domain_map=domain_map,
+        )
     pstats = pipeline_stats
     run_log = pstats.run_log if pstats is not None else None
     if run_log is not None or not cfg.run_log_dir:
@@ -992,7 +1012,7 @@ def fit_subsets_chunked(
             mesh=mesh, chunk_size=chunk_size, progress=progress,
             stop_after_chunks=stop_after_chunks, nan_guard=nan_guard,
             pipeline_stats=pstats, run_log=run_log,
-            domain_map=domain_map,
+            domain_map=domain_map, subset_keys=subset_keys,
         )
     from smk_tpu.obs.events import open_run_log
 
@@ -1024,10 +1044,245 @@ def fit_subsets_chunked(
                 stop_after_chunks=stop_after_chunks,
                 nan_guard=nan_guard,
                 pipeline_stats=pstats, run_log=run_log,
-                domain_map=domain_map,
+                domain_map=domain_map, subset_keys=subset_keys,
             )
     finally:
         run_log.close()
+
+
+def _n_work_chunks(pstats: ChunkPipelineStats) -> int:
+    """Chunks of real device work recorded so far (the ragged
+    driver's ``stop_after_chunks`` ledger) — the overlap drain entry
+    is host bookkeeping, not a chunk."""
+    return sum(
+        1 for c in pstats.chunks if c.get("phase") != "drain"
+    )
+
+
+def _fit_ragged_chunked(
+    model: SpatialGPSampler,
+    part: PaddedPartition,
+    coords_test: jnp.ndarray,
+    x_test: jnp.ndarray,
+    key: jax.Array,
+    beta_init: Optional[jnp.ndarray] = None,
+    *,
+    chunk_iters: int = 500,
+    checkpoint_path: Optional[str] = None,
+    mesh=None,
+    chunk_size: Optional[int] = None,
+    progress=None,
+    stop_after_chunks: Optional[int] = None,
+    nan_guard: bool = False,
+    pipeline_stats: Optional[ChunkPipelineStats] = None,
+    domain_map: Optional[FailureDomainMap] = None,
+) -> Optional[SubsetResult]:
+    """Ragged-partition driver (ISSUE 15): run one ordinary equal-m
+    chunked fit per OCCUPIED bucket of a
+    :class:`~smk_tpu.parallel.partition.PaddedPartition` (ascending
+    bucket order) and stitch the per-subset results back into
+    original subset order.
+
+    Every group fit is the unmodified :func:`_fit_subsets_chunked_impl`
+    — same chunk/stats/finalize/refork programs, same L1/L2 bucket
+    keys (``k`` = the group's subset count, ``m`` = its bucket), same
+    quarantine/checkpoint/streaming machinery — so a ragged fit
+    compiles at most one program set per occupied bucket cold, and a
+    warm store serves it with zero backend compiles
+    (RAGGED_r16.jsonl). Invariants this driver owns:
+
+    - **Global PRNG identity**: per-subset keys are split ONCE over
+      the ragged K (``subset_chain_keys(key, K)``) and sliced per
+      group, so a subset's chain depends on its global index and
+      data only — a PaddedPartition whose subsets all occupy one
+      exact-size bucket is bit-identical (draws AND bucket keys) to
+      the same subsets fit as a plain equal-m :class:`Partition`.
+    - **Checkpoint sharding**: each group checkpoints to its own
+      ``<path>.bNNNNN`` manifest (v6/v7 semantics per group,
+      identity-stamped with the group's sliced keys); kill/resume
+      replays only the groups the kill interrupted — completed
+      groups reload their finished draws bit-identically.
+    - **Fault attribution in GLOBAL indices**:
+      :class:`SubsetNaNError` subset ids and the pipeline-stats
+      fault events are remapped from group-local rows to original
+      subset indices before they reach the caller.
+    - ``stop_after_chunks`` budgets the RUN, not a group: the ledger
+      spends on each group's recorded work chunks and the run
+      truncates (returns None, checkpoints on disk) when it runs
+      out.
+    """
+    cfg = model.config
+    if domain_map is not None:
+        raise ValueError(
+            "domain_map is derived per bucket group on a ragged fit "
+            "— an explicit map cannot span groups of different K"
+        )
+    k_total = part.n_subsets
+    keys_all = subset_chain_keys(key, k_total, cfg.n_chains)
+    pstats = pipeline_stats
+    run_log = pstats.run_log if pstats is not None else None
+    opened_log = None
+    if run_log is None and cfg.run_log_dir:
+        from smk_tpu.obs.events import open_run_log
+
+        opened_log = run_log = open_run_log(
+            cfg.run_log_dir,
+            name="fit_subsets_ragged",
+            meta={
+                "n_subsets": k_total,
+                "buckets": list(part.buckets),
+                "sizes": list(part.sizes),
+                "n_samples": cfg.n_samples,
+                "chunk_iters": chunk_iters,
+            },
+        )
+    if pstats is None and (
+        run_log is not None or stop_after_chunks is not None
+    ):
+        pstats = ChunkPipelineStats()
+    if run_log is not None and pstats is not None:
+        pstats.run_log = run_log
+
+    group_results = []
+    ragged_groups = []
+    remaining = stop_after_chunks
+    root_span = (
+        run_log.span(
+            "fit_subsets_ragged", n_subsets=k_total,
+            buckets=list(part.buckets),
+        )
+        if run_log is not None else contextlib.nullcontext()
+    )
+    try:
+        with root_span:
+            for gi, g in enumerate(part.groups):
+                ids = list(g.subset_ids)
+                sub_keys = keys_all[jnp.asarray(ids)]
+                gpath = (
+                    None if checkpoint_path is None
+                    else f"{checkpoint_path}.b{g.bucket:05d}"
+                )
+                gprog = None
+                if progress is not None:
+                    def gprog(info, _b=g.bucket, _ids=tuple(ids)):
+                        progress(
+                            {**info, "bucket": _b,
+                             "subset_ids": list(_ids)}
+                        )
+                gspan = (
+                    run_log.span(
+                        "bucket_group", bucket=g.bucket,
+                        n_subsets=len(ids),
+                    )
+                    if run_log is not None
+                    else contextlib.nullcontext()
+                )
+                chunks_before = (
+                    _n_work_chunks(pstats) if pstats is not None
+                    else 0
+                )
+                # raw list index for the ESS window (the budget
+                # ledger above filters drain entries; a slice must
+                # not)
+                entries_before = (
+                    len(pstats.chunks) if pstats is not None else 0
+                )
+                faults_before = (
+                    len(pstats.fault_events)
+                    if pstats is not None else 0
+                )
+                with gspan:
+                    try:
+                        res = _fit_subsets_chunked_impl(
+                            model, g.part, coords_test, x_test,
+                            key, beta_init,
+                            chunk_iters=chunk_iters,
+                            checkpoint_path=gpath, mesh=mesh,
+                            chunk_size=chunk_size, progress=gprog,
+                            stop_after_chunks=remaining,
+                            nan_guard=nan_guard,
+                            pipeline_stats=pstats, run_log=run_log,
+                            domain_map=None, subset_keys=sub_keys,
+                        )
+                    except SubsetNaNError as e:
+                        # group-local rows -> original subset ids:
+                        # the abort contract names shards the CALLER
+                        # can rerun_subsets
+                        raise SubsetNaNError(
+                            [ids[j] for j in e.subset_ids],
+                            e.iteration,
+                        ) from e
+                if pstats is not None:
+                    _remap_fault_events(
+                        pstats, faults_before, ids
+                    )
+                    ragged_groups.append({
+                        "bucket": int(g.bucket),
+                        "n_subsets": len(ids),
+                        "live_ess_sum_final": _group_ess_final(
+                            pstats, entries_before
+                        ),
+                    })
+                    pstats.ragged_groups = ragged_groups
+                if res is None:
+                    return None
+                if remaining is not None and pstats is not None:
+                    remaining -= (
+                        _n_work_chunks(pstats) - chunks_before
+                    )
+                    if remaining <= 0 and gi < len(part.groups) - 1:
+                        # budget exhausted exactly at a group
+                        # boundary with groups left: the run is
+                        # truncated (the stop_after_chunks contract
+                        # — checkpoints on disk, None returned)
+                        return None
+                group_results.append(res)
+    finally:
+        if opened_log is not None:
+            if pstats is not None:
+                opened_log.close(pipeline=pstats.aggregate())
+            else:  # pragma: no cover - pstats created above
+                opened_log.close()
+
+    # stitch: groups are ascending-bucket concatenations of original
+    # subsets — invert the permutation so result row j is subset j
+    order = [j for g in part.groups for j in g.subset_ids]
+    inv = jnp.asarray(np.argsort(np.asarray(order)))
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.concatenate(leaves, axis=0)[inv],
+        *group_results,
+    )
+
+
+def _remap_fault_events(
+    pstats: ChunkPipelineStats, start: int, ids: list
+) -> None:
+    """Rewrite the fault events a group fit recorded (group-local
+    subset rows) into ORIGINAL subset indices, so
+    ``fault_summary()`` / bench records never name a ragged fit's
+    subsets by their position inside a bucket group."""
+    for ev in pstats.fault_events[start:]:
+        for field in ("retried", "dropped", "deferred"):
+            if field in ev:
+                ev[field] = [ids[j] for j in ev[field]]
+        if "attempts" in ev:
+            ev["attempts"] = {
+                ids[j]: n for j, n in ev["attempts"].items()
+            }
+
+
+def _group_ess_final(
+    pstats: ChunkPipelineStats, start: int
+) -> Optional[float]:
+    """The last streaming total-ESS value a group's chunks recorded
+    (None when live_diagnostics is off) — summed across groups by
+    ``ChunkPipelineStats.aggregate`` into the convergence-adjusted
+    ``ess_per_second`` denominator's numerator."""
+    vals = [
+        c["live_ess_sum"] for c in pstats.chunks[start:]
+        if c.get("live_ess_sum") is not None
+    ]
+    return vals[-1] if vals else None
 
 
 def _fit_subsets_chunked_impl(
@@ -1048,6 +1303,7 @@ def _fit_subsets_chunked_impl(
     pipeline_stats: Optional[ChunkPipelineStats] = None,
     run_log=None,
     domain_map: Optional[FailureDomainMap] = None,
+    subset_keys=None,
 ) -> Optional[SubsetResult]:
     """Unified chunked K-subset executor: the whole MCMC (burn-in AND
     sampling) runs as a host loop of ``chunk_iters``-long compiled
@@ -1146,7 +1402,18 @@ def _fit_subsets_chunked_impl(
         raise ValueError(f"chunk_iters must be >= 1, got {chunk_iters}")
     k = part.n_subsets
     data = stacked_subset_data(part, coords_test, x_test)
-    keys = subset_chain_keys(key, k, cfg.n_chains)
+    # subset_keys (ISSUE 15): the ragged driver pre-splits one key
+    # array over the GLOBAL subset count and hands each bucket group
+    # its slice — a subset's chain then depends on its global index,
+    # not its group row. Equal-m callers pass None and get the
+    # historical split byte-identically.
+    keys = (
+        subset_keys if subset_keys is not None
+        else subset_chain_keys(key, k, cfg.n_chains)
+    )
+    # the run-identity key component must cover what actually seeds
+    # the chains (the sliced key stack under the ragged driver)
+    ident_key = key if subset_keys is None else subset_keys
 
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -1270,7 +1537,7 @@ def _fit_subsets_chunked_impl(
         # wrong-config tripwire single-host runs have (the v7 scheme
         # skipped multi-process runs entirely)
         ident = dist_ckpt.distributed_run_identity(
-            cfg, key, data, beta_init,
+            cfg, ident_key, data, beta_init,
             timeout_s=cfg.ckpt_commit_timeout_s,
         )
     elif multi_process_mesh:
@@ -1278,7 +1545,7 @@ def _fit_subsets_chunked_impl(
         # guard checkpoints, so nothing consumes it here
         ident = np.zeros(1, np.uint32)
     else:
-        ident = _run_identity(cfg, key, data, beta_init)
+        ident = _run_identity(cfg, ident_key, data, beta_init)
     like = {
         "state": init_like,
         "it": np.asarray([0], np.int64),
@@ -2154,6 +2421,16 @@ def _fit_subsets_chunked_impl(
                 float(np.nanmin(live_es))
                 if np.isfinite(live_es).any() else float("nan"),
             )
+            # total streaming ESS across subsets at this boundary
+            # (per-subset min over parameters, summed over K) — the
+            # numerator of the convergence-adjusted ess_per_second
+            # bench metric (ISSUE 15 satellite of ROADMAP item 3)
+            live_ess_sum = (
+                float(np.nansum(np.where(
+                    np.isfinite(live_es), live_es, 0.0
+                )))
+                if np.isfinite(live_es).any() else None
+            )
             if run_log is not None:
                 run_log.event(
                     "live_diagnostics", iteration=b["it"],
@@ -2184,6 +2461,7 @@ def _fit_subsets_chunked_impl(
             if live_vals is not None:
                 entry["live_rhat_max"] = live_vals[0]
                 entry["live_ess_min"] = live_vals[1]
+                entry["live_ess_sum"] = live_ess_sum
             mem = mem_sample() if mem_sample is not None else None
             if mem is not None:
                 entry["hbm_bytes_in_use"] = mem.get("bytes_in_use")

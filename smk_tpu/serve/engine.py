@@ -48,6 +48,7 @@ from typing import NamedTuple, Optional
 
 import numpy as np
 
+from smk_tpu.compile.buckets import select_bucket, slice_plan
 from smk_tpu.serve.artifact import FitArtifact, load_artifact
 from smk_tpu.serve.deadline import (
     DeadlineBudget,
@@ -383,10 +384,11 @@ class PredictionEngine:
     # -- admission + serving ---------------------------------------
 
     def _bucket_for(self, n: int) -> int:
-        for b in self.buckets:
-            if b >= n:
-                return b
-        return self.buckets[-1]
+        # one source of truth for ladder selection (ISSUE 15):
+        # compile/buckets.select_bucket IS the engine's historical
+        # smallest-fitting-bucket loop, hoisted — behavior
+        # byte-identical, regression-pinned in tests/test_ragged.py
+        return select_bucket(n, self.buckets)
 
     def _count(self, field: str, n: int = 1) -> None:
         with self._lock:
@@ -485,10 +487,13 @@ class PredictionEngine:
                      queued_s=round(queued_s, 6))
             if log is not None else contextlib.nullcontext()
         )
-        cap = self.buckets[-1]
         pq_parts, ps_parts, mask_parts, used = [], [], [], []
         with span:
-            for lo in range(0, n, cap):
+            # the micro-batch plan — max-bucket slices, each padded
+            # to its smallest fitting bucket — comes from the shared
+            # ladder math (compile/buckets.slice_plan: the same
+            # arithmetic the m-axis ragged partitions bucket with)
+            for lo, hi, u in slice_plan(n, self.buckets):
                 if budget.expired():
                     # an exhausted budget sheds typed BEFORE the
                     # device is touched — dispatching a slice that is
@@ -497,9 +502,8 @@ class PredictionEngine:
                     raise RequestTimeoutError(
                         rid, "dispatch", budget.total_s
                     )
-                sl_c = cq[lo: lo + cap]
-                sl_x = xq[lo: lo + cap]
-                u = self._bucket_for(sl_c.shape[0])
+                sl_c = cq[lo:hi]
+                sl_x = xq[lo:hi]
                 used.append(u)
                 bspan = (
                     log.span("bucket", bucket=u,

@@ -159,6 +159,13 @@ class ChunkPipelineStats:
     ckpt_commit_s: float = 0.0
     total_wall_s: float = 0.0
     run_log: Any = None
+    # ragged-fit group ledger (ISSUE 15, parallel/recovery.py
+    # _fit_ragged_chunked): one entry per bucket group — {bucket,
+    # n_subsets, live_ess_sum_final} — so aggregate()'s
+    # convergence-adjusted ess_per_second can sum every group's final
+    # streaming ESS instead of seeing only the last group's
+    # boundaries. None on equal-m runs.
+    ragged_groups: Any = None
     _lock: threading.Lock = field(
         default_factory=threading.Lock, repr=False
     )
@@ -353,6 +360,21 @@ class ChunkPipelineStats:
             "live_ess_min_final": self._last_chunk_field(
                 "live_ess_min"
             ),
+            # ISSUE 15: total streaming ESS at the final boundary
+            # (per-subset min over parameters, summed over subsets —
+            # summed over bucket groups on a ragged fit) and the
+            # convergence-adjusted throughput it buys per wall
+            # second. Streaming ESS is the batch-means health signal
+            # (obs/streaming.py tolerance contract), so this is a
+            # comparative speed metric, not a publication ESS.
+            "live_ess_sum_final": self._ess_sum_final(),
+            "ess_per_second": (
+                round(self._ess_sum_final() / wall, 4)
+                if wall > 0 and self._ess_sum_final() is not None
+                else None
+            ),
+            # per-bucket-group ledger on ragged fits (None otherwise)
+            "ragged_groups": self.ragged_groups,
             # ISSUE 7 fault-isolation accounting: policy, retry
             # ladder history, and the final dropped-subset set —
             # JSON-friendly (string subset ids) for bench/protocol
@@ -364,6 +386,21 @@ class ChunkPipelineStats:
             # warm-deployment signature ROADMAP item 3 targets
             **self.program_summary(),
         }
+
+    def _ess_sum_final(self):
+        """Final-boundary total streaming ESS: the last
+        ``live_ess_sum`` chunk value — or, on a ragged fit, the sum
+        of every bucket group's final value (the groups ran
+        sequentially; the last chunk belongs to the last group
+        only)."""
+        if self.ragged_groups:
+            vals = [
+                g.get("live_ess_sum_final")
+                for g in self.ragged_groups
+            ]
+            vals = [v for v in vals if v is not None]
+            return sum(vals) if vals else None
+        return self._last_chunk_field("live_ess_sum")
 
     def _last_chunk_field(self, name: str, reduce=None):
         """The last (or ``reduce``-d) non-None per-chunk value of an

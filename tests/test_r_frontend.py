@@ -404,6 +404,44 @@ class TestResilienceKnobs:
         ):
             SMKConfig(ckpt_commit_timeout_s=0.0)
 
+    def test_partition_method_and_ladder_wired(self):
+        """The ISSUE 15 front-end additions: R ``partition.method``
+        (match.arg over random/coherent) and ``bucket.ladder``
+        (NULL = automatic √2 ladder) must exist and feed the
+        matching SMKConfig fields — source-checked like their
+        siblings, plus the config-side validation the R doubles
+        route through."""
+        import os
+
+        from smk_tpu.config import SMKConfig
+
+        r_src = open(
+            os.path.join(
+                os.path.dirname(os.path.dirname(__file__)),
+                "r", "meta_kriging_tpu.R",
+            )
+        ).read()
+        assert 'partition.method = c("random",' in r_src
+        assert "bucket.ladder = NULL" in r_src
+        assert "partition_method = partition.method" in r_src
+        assert (
+            "bucket_ladder = if (is.null(bucket.ladder)) NULL else"
+            in r_src
+        )
+        # config-side contract: the fields exist, validate, and the
+        # ladder normalizes to an ascending tuple (reticulate may
+        # ship an R integer vector as a list)
+        assert SMKConfig(
+            partition_method="coherent"
+        ).partition_method == "coherent"
+        assert SMKConfig(
+            bucket_ladder=[8, 16, 32]
+        ).bucket_ladder == (8, 16, 32)
+        with pytest.raises(ValueError, match="partition_method"):
+            SMKConfig(partition_method="zorder")
+        with pytest.raises(ValueError, match="ascending"):
+            SMKConfig(bucket_ladder=(16, 8))
+
     def test_config_accepts_r_double_spellings(self):
         """reticulate ships R numerics as Python floats: the new
         int-like knob must coerce (dist_init_retries) and the float

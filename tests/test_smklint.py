@@ -1197,10 +1197,87 @@ class TestDeadlineDiscipline:
         assert "SMK114" in rules_hit(broken, path=real)
 
 
+class TestLadderDiscipline:
+    """SMK115 (ISSUE 15): padded-shape / bucket-size arithmetic in
+    smk_tpu/ library code outside compile/buckets.py — the √2-rung
+    signatures (`base ** (x / 2)`, `2 ** 0.5`, `sqrt(2)` in any
+    spelling) — is a finding: a second ladder implementation that
+    drifts by one rounding rule would fragment the compile store."""
+
+    def test_half_power_rung_flagged(self):
+        src = (
+            "import math\n"
+            "def my_bucket(m):\n"
+            "    i = math.ceil(2 * math.log2(m))\n"
+            "    return int(round(2 ** (i / 2)))\n"
+        )
+        assert "SMK115" in rules_hit(src)
+
+    def test_sqrt2_constant_flagged_all_spellings(self):
+        for expr in (
+            "math.sqrt(2)", "np.sqrt(2.0)", "jnp.sqrt(2)",
+            "2 ** 0.5",
+        ):
+            src = (
+                "import math\nimport numpy as np\n"
+                "import jax.numpy as jnp\n"
+                f"LADDER_STEP = {expr}\n"
+            )
+            assert "SMK115" in rules_hit(src), expr
+
+    def test_from_import_sqrt_alias_flagged(self):
+        src = (
+            "from math import sqrt as _rt\n"
+            "STEP = _rt(2)\n"
+        )
+        assert "SMK115" in rules_hit(src)
+
+    def test_generic_numerics_pass(self):
+        src = (
+            "import math\n"
+            "def f(x, n):\n"
+            "    a = math.sqrt(x)\n"       # variable sqrt is legal
+            "    b = x ** 0.5\n"           # non-2 base is legal
+            "    c = x ** (n / 3)\n"       # non-/2 exponent is legal
+            "    d = (x + 1) / 2\n"        # plain halving is legal
+            "    return a + b + c + d\n"
+        )
+        assert "SMK115" not in rules_hit(src)
+
+    def test_buckets_module_and_nonlibrary_exempt(self):
+        src = "STEP = 2 ** 0.5\n"
+        assert "SMK115" not in rules_hit(
+            src, path="smk_tpu/compile/buckets.py"
+        )
+        assert "SMK115" not in rules_hit(src, path=TESTS_PATH)
+        assert "SMK115" not in rules_hit(src, path=SCRIPT_PATH)
+
+    def test_suppression_with_justification(self):
+        src = (
+            "import math\n"
+            "STEP = math.sqrt(2)  "
+            "# smklint: disable=SMK115 -- doc example, not a ladder\n"
+        )
+        hits = rules_hit(src)
+        assert "SMK115" not in hits and "SMK100" not in hits
+
+    def test_real_partition_clean_and_seeded_defect_caught(self):
+        real = "smk_tpu/parallel/partition.py"
+        src = repo_file(real)
+        assert "SMK115" not in rules_hit(src, path=real)
+        broken = src + (
+            "\n\ndef _local_bucket_for(m):\n"
+            "    import math\n"
+            "    return int(round(\n"
+            "        2 ** (math.ceil(2 * math.log2(m)) / 2)))\n"
+        )
+        assert "SMK115" in rules_hit(broken, path=real)
+
+
 @pytest.mark.parametrize("rule_id", [
     "SMK101", "SMK102", "SMK103", "SMK104", "SMK105", "SMK106",
     "SMK107", "SMK108", "SMK109", "SMK110", "SMK111", "SMK112",
-    "SMK113", "SMK114",
+    "SMK113", "SMK114", "SMK115",
 ])
 def test_every_rule_documented_in_catalogue(rule_id):
     from smk_tpu.analysis.lint import _list_rules
