@@ -1083,13 +1083,23 @@ def run_rung_serve_latency(name, *, solver_env=None, n=None, k=None,
     ``program_sources`` / ``requests_shed`` / ``rows_degraded``
     top-level — the serving axis's own telemetry contract.
     BENCH_SERVE_N / BENCH_SERVE_K / BENCH_SERVE_BATCH /
-    BENCH_SERVE_REQUESTS resize it.
+    BENCH_SERVE_REQUESTS resize it. ISSUE 16:
+    BENCH_SERVE_COALESCE_MS arms cross-request coalescing and
+    BENCH_SERVE_REPLICAS > 1 serves through a shared-store
+    ReplicaFleet — the rung stamps ``coalesce_window_ms`` /
+    ``coalesce_batches`` / ``coalesced_requests`` / ``n_replicas``
+    top-level (scripts/serve_load_probe.py is the closed-loop
+    max-QPS sibling, SERVE_LOAD_r17.jsonl).
     """
     import tempfile
     import threading
 
     from smk_tpu.api import fit_meta_kriging
-    from smk_tpu.serve import PredictionEngine, save_artifact
+    from smk_tpu.serve import (
+        PredictionEngine,
+        ReplicaFleet,
+        save_artifact,
+    )
     from smk_tpu.utils.tracing import ChunkPipelineStats
 
     env = solver_env or {}
@@ -1100,6 +1110,10 @@ def run_rung_serve_latency(name, *, solver_env=None, n=None, k=None,
     )
     batch = int(os.environ.get("BENCH_SERVE_BATCH", 32))
     n_req = int(os.environ.get("BENCH_SERVE_REQUESTS", 64))
+    coalesce_ms = float(
+        os.environ.get("BENCH_SERVE_COALESCE_MS", "0")
+    )
+    n_replicas = int(os.environ.get("BENCH_SERVE_REPLICAS", "1"))
     cfg = rung_config(
         env, k=k, n_samples=n_samples, cov_model="exponential",
         link="probit",
@@ -1135,15 +1149,22 @@ def run_rung_serve_latency(name, *, solver_env=None, n=None, k=None,
     cold.predict(req_c[0], req_x[0], seed=0)
     cold_first_s = time.time() - t0
 
-    # AOT-warm: a second engine warms through the L2 store at
-    # construction, so its first request is pure execution
+    # AOT-warm: a second engine (or an N-replica fleet on the same
+    # store) warms through the L2 store at construction, so its
+    # first request is pure execution
     pstats = ChunkPipelineStats()
-    t0 = time.time()
-    engine = PredictionEngine(
-        artifact_path, buckets=buckets, max_queue=256,
-        max_in_flight=4, compile_store_dir=store,
-        pipeline_stats=pstats, default_deadline_s=600.0,
+    eng_kw = dict(
+        buckets=buckets, max_queue=256, max_in_flight=4,
+        compile_store_dir=store, pipeline_stats=pstats,
+        default_deadline_s=600.0, coalesce_window_ms=coalesce_ms,
     )
+    t0 = time.time()
+    if n_replicas > 1:
+        engine = ReplicaFleet(
+            artifact_path, n_replicas=n_replicas, **eng_kw
+        )
+    else:
+        engine = PredictionEngine(artifact_path, **eng_kw)
     warm_build_s = time.time() - t0
     t0 = time.time()
     warm_first = engine.predict(req_c[0], req_x[0], seed=0)
@@ -1198,6 +1219,15 @@ def run_rung_serve_latency(name, *, solver_env=None, n=None, k=None,
         str(c): measure(c) for c in (1, 8, 64)
     }
     health = engine.health()
+    # fleet health nests the summed admission counters under
+    # "totals"; a single engine reports them top-level
+    totals = health.get("totals", health)
+    if n_replicas > 1:
+        co_stats = [
+            r.get("coalesce", {}) for r in health["replicas"]
+        ]
+    else:
+        co_stats = [health.get("coalesce", {})]
     return {
         "rung": name,
         "n": n, "K": k, "m": n // k, "iters": n_samples,
@@ -1205,16 +1235,28 @@ def run_rung_serve_latency(name, *, solver_env=None, n=None, k=None,
         "n_draws": int(np.asarray(res.sample_par).shape[0]),
         "n_anchor": int(coords_test.shape[0]),
         "batch_rows": batch, "n_requests": n_req,
-        "buckets": list(engine.buckets),
+        "buckets": list(buckets),
         "cold_first_request_s": round(cold_first_s, 3),
         "warm_build_s": round(warm_build_s, 3),
         "warm_first_request_s": round(warm_first_s, 4),
         "concurrency": concurrency,
         "finite": bool(np.isfinite(warm_first.p_quant).all()),
-        "requests_shed": health["requests_shed"],
-        "requests_timed_out": health["requests_timed_out"],
-        "rows_degraded": health["rows_degraded"],
+        "requests_shed": totals["requests_shed"],
+        "requests_timed_out": totals["requests_timed_out"],
+        "rows_degraded": totals["rows_degraded"],
         "health_state": health["state"],
+        # ISSUE 16 stamps: the coalescing/fleet configuration and
+        # what it amortized (dispatches < served requests when the
+        # window packed concurrent callers together)
+        "coalesce_window_ms": coalesce_ms,
+        "n_replicas": n_replicas,
+        "dispatches": totals.get("dispatches", 0),
+        "coalesce_batches": sum(
+            c.get("batches", 0) for c in co_stats
+        ),
+        "coalesced_requests": sum(
+            c.get("requests", 0) for c in co_stats
+        ),
         "program_sources": pstats.program_summary()[
             "program_sources"
         ],

@@ -351,6 +351,14 @@ plot_smk_traces <- function(fit) {
 #   RequestTimeoutError within the deadline instead of hanging R.
 # compile.store.dir: optional ISSUE 8 L2 store — a warm store serves
 #   with zero XLA compiles.
+# coalesce.window.ms: ISSUE 16 cross-request coalescing window —
+#   milliseconds the engine may hold a request to pack it with
+#   concurrent ones into one padded ladder dispatch (NULL/0 = off,
+#   the per-request path). Deadline-aware: a request is never held
+#   past its budget, and held time is reported via held.s.
+# n.replicas: run N engine replicas (threads, one process) sharing
+#   the L2 store behind a shedding front door (serve/fleet.py);
+#   NULL/1 = a single engine.
 # one engine per (artifact, store) per R session: the engine's whole
 # design is that warm-up (artifact load + device_put + AOT compile
 # of the bucket ladder) happens ONCE and requests are pure execution
@@ -360,15 +368,21 @@ plot_smk_traces <- function(fit) {
 smk.predict.serve <- function(artifact.path, coords.query, x.query,
                               deadline.ms = NULL,
                               seed = 0,
-                              compile.store.dir = NULL) {
+                              compile.store.dir = NULL,
+                              coalesce.window.ms = NULL,
+                              n.replicas = NULL) {
   # the file's identity (mtime + size) rides the cache key: a
   # re-saved artifact at the same path must build a FRESH engine,
-  # never silently serve the stale fit
+  # never silently serve the stale fit. The serving topology knobs
+  # (coalescing window, replica count) ride it too — they change
+  # which engine object must exist, not how a request is phrased
   art_info <- file.info(artifact.path)
   eng_key <- paste0(
     artifact.path, "|",
     as.numeric(art_info$mtime), "|", art_info$size, "|",
-    if (is.null(compile.store.dir)) "" else compile.store.dir
+    if (is.null(compile.store.dir)) "" else compile.store.dir, "|",
+    if (is.null(coalesce.window.ms)) 0 else coalesce.window.ms, "|",
+    if (is.null(n.replicas)) 1 else n.replicas
   )
   eng <- get0(eng_key, envir = .smk.serve.engines)
   if (is.null(eng)) {
@@ -377,14 +391,24 @@ smk.predict.serve <- function(artifact.path, coords.query, x.query,
     if (!is.null(compile.store.dir)) {
       eng_args$compile_store_dir <- compile.store.dir
     }
-    eng <- do.call(serve$PredictionEngine, eng_args)
+    if (!is.null(coalesce.window.ms)) {
+      eng_args$coalesce_window_ms <- coalesce.window.ms
+    }
+    if (!is.null(n.replicas) && n.replicas > 1) {
+      eng_args$n_replicas <- as.integer(n.replicas)
+      eng <- do.call(serve$ReplicaFleet, eng_args)
+    } else {
+      eng <- do.call(serve$PredictionEngine, eng_args)
+    }
     # evict engines superseded by a re-save of the same artifact at
     # this (path, store) — their key differs only in mtime/size, and
     # without eviction a long-lived session (e.g. a Shiny server that
     # periodically re-exports the fit) pins one full engine — device
     # arrays + compiled bucket ladder — per re-export, forever
     store_sfx <- paste0(
-      "|", if (is.null(compile.store.dir)) "" else compile.store.dir
+      "|", if (is.null(compile.store.dir)) "" else compile.store.dir,
+      "|", if (is.null(coalesce.window.ms)) 0 else coalesce.window.ms,
+      "|", if (is.null(n.replicas)) 1 else n.replicas
     )
     stale <- Filter(
       function(k) {
@@ -417,6 +441,9 @@ smk.predict.serve <- function(artifact.path, coords.query, x.query,
     buckets = as.integer(unlist(res$buckets)),
     request.id = res$request_id,
     latency.s = res$latency_s,
+    # time the coalescer held this request before dispatch (ISSUE 16;
+    # 0 when coalesce.window.ms is off). latency.s includes it.
+    held.s = res$held_s,
     health = eng$health()
   )
 }

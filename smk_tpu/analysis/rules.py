@@ -1,4 +1,4 @@
-"""smklint rules SMK101–SMK115 — the repo's JAX invariants, each one
+"""smklint rules SMK101–SMK116 — the repo's JAX invariants, each one
 traceable to the PR that established it (see analysis/RULES.md).
 
 All rules are pure-AST (no jax import). Shared machinery:
@@ -1832,6 +1832,131 @@ class LadderDisciplineRule(Rule):
                     yield self.finding(module, node, msg_sqrt)
 
 
+# ---------------------------------------------------------------------------
+# SMK116 — coalesce-wait discipline (config-driven bounds on the
+# cross-request serving hot path)
+# ---------------------------------------------------------------------------
+
+# The two serving modules ISSUE 16 added. Both sit on EVERY request's
+# latency path when coalescing/fleets are armed, so their waits carry
+# a stricter contract than SMK111's bounded-at-all: the bound itself
+# must be derived from config or budget state, never a hard-coded
+# numeric literal.
+_COALESCE_ZONES = ("smk_tpu/serve/coalesce", "smk_tpu/serve/fleet")
+
+
+class BoundedCoalesceWaitRule(Rule):
+    id = "SMK116"
+    name = "coalesce-wait-discipline"
+    doc = (
+        "hard-coded wait bounds in the coalescer/fleet hot path "
+        "(smk_tpu/serve/coalesce.py, smk_tpu/serve/fleet.py) — any "
+        "time.sleep(...) call, and any blocking wait "
+        "(.get/.join/.result/.wait/.acquire/.accept) whose timeout "
+        "is a numeric literal rather than a config- or "
+        "budget-derived variable. These modules hold OTHER requests' "
+        "latency budgets while they wait (ISSUE 16): a literal "
+        "freezes a latency policy the operator can no longer tune "
+        "through SMKConfig.coalesce_window_ms or the request's "
+        "DeadlineBudget, and a sleep is an unconditional hold even "
+        "when the batch is ready to flush. Derive every bound from "
+        "the window/budget state (hold variables, budget.remaining())"
+    )
+
+    def applies(self, module):
+        norm = module.norm_path()
+        return any(z in norm for z in _COALESCE_ZONES)
+
+    @staticmethod
+    def _sleep_aliases(tree):
+        """Every local name time.sleep may be reached through:
+        module aliases (``import time [as t]``) and member aliases
+        (``from time import sleep [as snooze]``) — the same
+        from-import coverage SMK110/111 grew."""
+        mod_aliases, member_aliases = set(), set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "time":
+                        mod_aliases.add(a.asname or "time")
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "time" and node.level == 0:
+                    for a in node.names:
+                        if a.name == "sleep":
+                            member_aliases.add(a.asname or a.name)
+        return mod_aliases, member_aliases
+
+    @staticmethod
+    def _numeric_literal(node) -> bool:
+        """A bare int/float constant (optionally signed); bools are
+        not timeouts (lock.acquire(True) is a blocking flag)."""
+        if isinstance(node, ast.UnaryOp) and isinstance(
+            node.op, (ast.USub, ast.UAdd)
+        ):
+            node = node.operand
+        return (
+            isinstance(node, ast.Constant)
+            and not isinstance(node.value, bool)
+            and isinstance(node.value, (int, float))
+        )
+
+    def check(self, module, ctx):
+        sleep_mods, sleep_members = self._sleep_aliases(module.tree)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            is_sleep = (
+                len(chain) == 2
+                and chain[0] in sleep_mods
+                and chain[1] == "sleep"
+            ) or (len(chain) == 1 and chain[0] in sleep_members)
+            if is_sleep:
+                yield self.finding(
+                    module, node,
+                    "time.sleep(...) in the coalescer/fleet hot "
+                    "path — an unconditional hold that keeps "
+                    "sleeping after the batch is ready and ignores "
+                    "every member's deadline; wait on the batch "
+                    "condition variable with a window/budget-derived "
+                    "timeout instead",
+                )
+                continue
+            if not (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _WAIT_METHODS
+            ):
+                continue
+            flagged = False
+            for kw in node.keywords:
+                if kw.arg in _TIMEOUT_KWARGS and self._numeric_literal(
+                    kw.value
+                ):
+                    yield self.finding(
+                        module, node,
+                        f".{node.func.attr}({kw.arg}=<literal>) — a "
+                        "hard-coded wait bound in the coalescer/"
+                        "fleet hot path freezes a latency policy the "
+                        "operator cannot tune; derive the timeout "
+                        "from coalesce_window_ms or the request's "
+                        "DeadlineBudget (budget.remaining())",
+                    )
+                    flagged = True
+                    break
+            if not flagged and node.args and self._numeric_literal(
+                node.args[0]
+            ):
+                yield self.finding(
+                    module, node,
+                    f".{node.func.attr}(<numeric literal>) — a "
+                    "hard-coded wait bound in the coalescer/fleet "
+                    "hot path freezes a latency policy the operator "
+                    "cannot tune; derive the bound from "
+                    "coalesce_window_ms or the request's "
+                    "DeadlineBudget (budget.remaining())",
+                )
+
+
 ALL_RULES = [
     BatchingRuleRule(),
     HostNondeterminismRule(),
@@ -1848,4 +1973,5 @@ ALL_RULES = [
     AtomicWriteRule(),
     DeadlineDisciplineRule(),
     LadderDisciplineRule(),
+    BoundedCoalesceWaitRule(),
 ]

@@ -102,6 +102,11 @@ _DIGEST_NEUTRAL = dict(
     # store serves random and coherent partitions alike
     partition_method="random",
     bucket_ladder=None,
+    # serving-side coalescing window (ISSUE 16): pure request
+    # scheduling in serve/coalesce.py — the serve program keys carry
+    # their own variant kind ("serve_predict" vs "serve_predict_rs"),
+    # and no fit program ever sees the knob
+    coalesce_window_ms=0.0,
 )
 
 

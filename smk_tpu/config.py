@@ -449,6 +449,20 @@ class SMKConfig:
     watchdog_min_deadline_s: float = 60.0
     watchdog_margin: float = 10.0
 
+    # Cross-request coalescing window for the serving path (ISSUE 16,
+    # smk_tpu/serve/coalesce.py): milliseconds a PredictionEngine may
+    # hold an admitted predict() request to pack it with concurrent
+    # requests into one padded ladder dispatch. 0 (default) disables
+    # coalescing — the per-request dispatch path and its program keys
+    # are byte-identical to the pre-coalescer engine. Pure
+    # serving-side scheduling: the fit chain never sees it, so it is
+    # normalized out of the run-identity hash and the compile digest
+    # like the other serve/obs knobs. The hold is DEADLINE-AWARE: a
+    # request is never held past the point where window + dispatch
+    # estimate would blow its budget (serve/coalesce.py flushes the
+    # batch immediately for a deadline-critical request).
+    coalesce_window_ms: float = 0.0
+
     # AOT program store (ISSUE 8; smk_tpu/compile/) — the cold-compile
     # killers for the public chunked path (ROADMAP open item 3:
     # compile_s=120.4 > fit_s=70.1 at north-star shapes):
@@ -714,6 +728,11 @@ class SMKConfig:
             raise ValueError(
                 "watchdog_margin must be >= 1 — a deadline below the "
                 "observed chunk wall would kill healthy chunks"
+            )
+        if self.coalesce_window_ms < 0:
+            raise ValueError(
+                "coalesce_window_ms must be >= 0 (0 disables "
+                "cross-request coalescing)"
             )
         for name in (
             "compile_store_dir", "xla_cache_dir", "run_log_dir",

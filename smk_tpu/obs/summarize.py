@@ -141,6 +141,74 @@ def chunk_breakdown(run: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
+# held-time histogram bucket edges (ms) for the serve block — fixed
+# analysis-side bins so two logs' histograms line up regardless of
+# their configured windows
+_HELD_EDGES_MS = (1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+                  1000.0)
+
+
+def _held_histogram(held_s: List[float]) -> Dict[str, int]:
+    hist: Dict[str, int] = {}
+    for h in held_s:
+        ms = float(h) * 1000.0
+        for edge in _HELD_EDGES_MS:
+            if ms < edge:
+                label = f"<{edge:g}ms"
+                break
+        else:
+            label = f">={_HELD_EDGES_MS[-1]:g}ms"
+        hist[label] = hist.get(label, 0) + 1
+    return hist
+
+
+def serve_block(run: Dict[str, Any]) -> Dict[str, Any]:
+    """The serving-side view of a run log (ISSUE 16): coalesced-batch
+    occupancy from the ``coalesce`` spans, the held-time histogram
+    from their per-request ``held_s`` attrs, and the shed/served
+    counters the engine (or fleet) stamped into ``run_end``. All
+    zeros/empty on a fit log — the block only renders when serve
+    activity exists."""
+    req_spans = [s for s in run["spans"] if s["name"] == "request"]
+    co_spans = [s for s in run["spans"] if s["name"] == "coalesce"]
+    end_attrs = (run["end"] or {}).get("attrs", {})
+    stats = end_attrs.get("serve") or end_attrs.get("fleet") or {}
+    held = [
+        float(h)
+        for s in co_spans
+        for h in (s["attrs"].get("held_s") or [])
+    ]
+    n_req = [int(s["attrs"].get("n_requests", 0)) for s in co_spans]
+    n_rows = [int(s["attrs"].get("rows", 0)) for s in co_spans]
+    shed_keys = (
+        "requests_served", "requests_shed", "requests_timed_out",
+        "requests_rejected", "dispatches", "requests_shed_fleet",
+        "replica_fallthroughs",
+    )
+    return {
+        "n_request_spans": len(req_spans),
+        "coalesce": {
+            "n_batches": len(co_spans),
+            "requests": sum(n_req),
+            "rows": sum(n_rows),
+            "mean_requests_per_batch": (
+                round(sum(n_req) / len(co_spans), 2)
+                if co_spans else None
+            ),
+            "max_requests_per_batch": max(n_req, default=None),
+            "mean_rows_per_batch": (
+                round(sum(n_rows) / len(co_spans), 2)
+                if co_spans else None
+            ),
+        },
+        "held_s_hist": _held_histogram(held),
+        "held_s_max": round(max(held), 6) if held else None,
+        "sheds": {
+            k: stats[k] for k in shed_keys if k in stats
+        },
+    }
+
+
 def summarize(path: str) -> Dict[str, Any]:
     """The full machine-readable summary of one run log."""
     run = load_run(path)
@@ -240,6 +308,9 @@ def summarize(path: str) -> Dict[str, Any]:
             "n_boundaries": len(live),
             "final": live[-1] if live else None,
         },
+        # ISSUE 16: the serving-side view — coalesced-batch
+        # occupancy, held-time histogram, shed counters
+        "serve": serve_block(run),
         "counters": (run["end"] or {}).get("counters", {}),
     }
 
@@ -356,4 +427,26 @@ def main(argv: List[str]) -> int:
             f"\nlive diagnostics: {live['n_boundaries']} boundaries, "
             f"final {live['final']}"
         )
+    sv = summary["serve"]
+    if sv["n_request_spans"] or sv["coalesce"]["n_batches"] or sv[
+        "sheds"
+    ]:
+        co = sv["coalesce"]
+        print(
+            f"\nserve: {sv['n_request_spans']} request span(s), "
+            f"{co['n_batches']} coalesced batch(es)"
+            + (
+                f" (occupancy {co['mean_requests_per_batch']} "
+                f"req/batch, max {co['max_requests_per_batch']}; "
+                f"{co['mean_rows_per_batch']} rows/batch)"
+                if co["n_batches"] else ""
+            )
+        )
+        if sv["held_s_hist"]:
+            print(
+                f"  held-time histogram: {sv['held_s_hist']} "
+                f"(max {sv['held_s_max']}s)"
+            )
+        if sv["sheds"]:
+            print(f"  admission counters: {sv['sheds']}")
     return 0

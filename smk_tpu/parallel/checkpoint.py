@@ -402,6 +402,9 @@ def identity_config_repr(cfg) -> bytes:
         # entry path that reconstructs the same padded data/keys
         partition_method="random",
         bucket_ladder=None,
+        # serve-side coalescing window (ISSUE 16): request scheduling
+        # in serve/coalesce.py — the fit chain never sees it
+        coalesce_window_ms=0.0,
     )
     return repr(cfg_ident).encode()
 

@@ -2,7 +2,13 @@
 prediction engine over a frozen fit artifact — AOT-warm shape-bucket
 ladder (zero request-time compile), bounded admission with typed
 load-shedding, per-request deadlines, per-row NaN quarantine with
-health states. See serve/engine.py for the full contract."""
+health states. See serve/engine.py for the full contract.
+
+ISSUE 16 adds cross-request coalescing (serve/coalesce.py — pack
+concurrent requests into one padded ladder dispatch within a
+deadline-aware window) and shared-store replica fleets
+(serve/fleet.py — N engines behind a shedding front door, zero
+compiles per replica on a warm store)."""
 
 from smk_tpu.serve.artifact import (
     ArtifactError,
@@ -10,6 +16,7 @@ from smk_tpu.serve.artifact import (
     load_artifact,
     save_artifact,
 )
+from smk_tpu.serve.coalesce import RequestCoalescer
 from smk_tpu.serve.deadline import (
     DeadlineBudget,
     RequestTimeoutError,
@@ -21,6 +28,7 @@ from smk_tpu.serve.engine import (
     PredictResponse,
     QueueFullError,
 )
+from smk_tpu.serve.fleet import FleetSaturatedError, ReplicaFleet
 
 __all__ = [
     "ArtifactError",
@@ -34,4 +42,7 @@ __all__ = [
     "PredictionEngine",
     "PredictResponse",
     "QueueFullError",
+    "RequestCoalescer",
+    "FleetSaturatedError",
+    "ReplicaFleet",
 ]

@@ -1,0 +1,334 @@
+"""Cross-request coalescing for the prediction engine (ISSUE 16).
+
+PR 13's engine batches only WITHIN a request: N concurrent requests
+pay N padded ladder dispatches even when their query rows would fit
+in one rung. This module is the continuous-batching admission stage
+that fixes that — the LLM-serving trick applied to kriging, on
+infrastructure the repo already owns (the √2 query ladder quantizes
+shapes; the row-seed ``serve_predict_rs`` program makes the noise
+packing-invariant).
+
+**Protocol** (leader/follower, no dedicated scheduler thread): the
+first request to arrive at an empty coalescer becomes the batch
+LEADER. It waits on a condition variable for at most the coalescing
+window — shrunk to the tightest member's deadline headroom
+(``remaining - safety × dispatch estimate``) so no request is ever
+held past the point where ``window + dispatch`` would blow its
+budget — then takes every pending request, concatenates their query
+rows, acquires the engine's in-flight gate ON BEHALF of the batch,
+dispatches the packed rows through the shared ladder
+(``compile/buckets.slice_plan`` over the total), and scatters result
+rows back per request, each with its own NaN-quarantine mask (the
+SERVE_r15 partial-response contract applies per request: one
+request's poisoned rows never degrade its batch-mates). Followers
+wait on a private event bounded by their own budget. A
+deadline-critical arrival — one whose headroom is already gone —
+flushes the batch IMMEDIATELY (the leader is woken early; a critical
+LEADER skips the window outright, so its ``held_s`` ≈ 0).
+
+Every wait in this module is bounded and derives from the configured
+window or a request's deadline budget — never a numeric literal
+(smklint SMK116; SMK111 already bans zero-argument waits tree-wide).
+Dispatches happen inside the engine's ``_dispatch_slice_rows``, which
+keeps the SMK114 run-under-deadline discipline.
+
+**Bit-identity**: a row's composition draw derives from its owning
+request's ``(seed, row index)`` (see ``engine._build_predict_rows``),
+so coalesced results are bit-identical to serving the same requests
+one at a time on a window-armed engine — only the packing changes.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from smk_tpu.compile.buckets import slice_plan
+from smk_tpu.serve.deadline import DeadlineBudget, RequestTimeoutError
+from smk_tpu.utils.tracing import monotonic
+
+# headroom multiplier on the observed dispatch wall when deciding how
+# long a request may be held: a request is flushed once
+# remaining < SAFETY × estimate, absorbing estimate noise (the same
+# margin idea as the chunk watchdog, sized for the short serve path)
+HOLD_SAFETY = 2.0
+
+# observed batch-dispatch walls kept for the hold estimate — recent
+# maximum, so one slow warm-up batch ages out
+_WALL_WINDOW = 8
+
+
+class _Pending:
+    """One admitted request parked in the coalescing window."""
+
+    __slots__ = (
+        "cq", "xq", "rid", "seed", "budget", "event", "box", "held_s",
+    )
+
+    def __init__(self, cq, xq, rid, seed, budget):
+        self.cq = cq
+        self.xq = xq
+        self.rid = rid
+        self.seed = int(seed)
+        self.budget = budget
+        self.event = threading.Event()
+        self.box: dict = {}
+        self.held_s = 0.0
+
+    @property
+    def n(self) -> int:
+        return self.cq.shape[0]
+
+
+class RequestCoalescer:
+    """Leader/follower batching stage in front of one engine's
+    dispatch path. Created by :class:`~smk_tpu.serve.engine.
+    PredictionEngine` when ``coalesce_window_ms > 0``; not part of
+    the public API."""
+
+    def __init__(self, engine, *, window_s: float):
+        if not (window_s > 0):
+            raise ValueError("coalescing window must be > 0 seconds")
+        self.engine = engine
+        self.window_s = float(window_s)
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._pending: list = []
+        self._flush_asap = False
+        # monotonic instant the current leader will flush at (None
+        # when no window is open) — arrivals compare their headroom
+        # against it to decide whether to force an early flush
+        self._flush_at: Optional[float] = None
+        self._walls: deque = deque(maxlen=_WALL_WINDOW)
+        self._ids = 0
+        self._stats = {
+            "batches": 0,
+            "requests": 0,
+            "rows": 0,
+            "max_batch_requests": 0,
+            "critical_flushes": 0,
+            "held_s_max": 0.0,
+        }
+
+    # -- deadline headroom -------------------------------------------
+
+    def dispatch_estimate_s(self) -> float:
+        """Recent max observed batch-dispatch wall (0 before the
+        first batch — nothing observed means nothing to budget
+        against, and the window alone bounds the hold)."""
+        return max(self._walls, default=0.0)
+
+    def _headroom_s(self, budget: DeadlineBudget) -> float:
+        """Seconds this request may still be HELD: raw remaining
+        budget minus a safety multiple of the expected dispatch wall.
+        <= 0 marks the request deadline-critical."""
+        raw = budget.total_s - budget.elapsed()
+        return raw - HOLD_SAFETY * self.dispatch_estimate_s()
+
+    # -- submission ---------------------------------------------------
+
+    def submit(self, cq, xq, rid, seed, budget) -> "PredictResponse":
+        """Park one admitted request; returns its response (the
+        caller — engine.predict — owns admission and error
+        accounting). The calling thread either leads the batch or
+        waits, bounded by its own budget."""
+        entry = _Pending(cq, xq, rid, seed, budget)
+        with self._cv:
+            self._pending.append(entry)
+            leader = len(self._pending) == 1
+            critical = self._headroom_s(budget) <= 0.0
+            if critical:
+                self._stats["critical_flushes"] += 1
+            if not leader and not critical and self._flush_at is not None:
+                # a non-critical arrival still forces an early flush
+                # when the open window outlives its headroom — held
+                # never exceeds what the deadline can absorb
+                critical_window = (
+                    monotonic() + self._headroom_s(budget)
+                    < self._flush_at
+                )
+                critical = critical_window
+            if critical and not leader:
+                self._flush_asap = True
+                self._cv.notify()
+        if leader:
+            self._lead(entry, critical)
+        else:
+            # bounded by this request's own budget: if the leader's
+            # batch outlives it, the request is shed typed while the
+            # batch completes for its surviving members
+            if not entry.event.wait(timeout=budget.remaining()):
+                raise RequestTimeoutError(rid, "held", budget.total_s)
+        return self._finish(entry)
+
+    # -- leader path ----------------------------------------------------
+
+    def _lead(self, entry: _Pending, critical: bool) -> None:
+        if not critical:
+            with self._cv:
+                # the hold is the window, shrunk to the tightest
+                # member's headroom — both config/budget-derived
+                # (SMK116), never a literal
+                hold = min(
+                    [self.window_s]
+                    + [self._headroom_s(e.budget)
+                       for e in self._pending]
+                )
+                if hold > 0 and not self._flush_asap:
+                    self._flush_at = monotonic() + hold
+                    self._cv.wait(timeout=hold)
+        with self._cv:
+            batch = list(self._pending)
+            self._pending.clear()
+            self._flush_asap = False
+            self._flush_at = None
+        self._flush(batch)
+
+    def _flush(self, batch) -> None:
+        """Dispatch one packed batch and deliver every member's rows
+        (or its typed failure) through its box + event."""
+        import contextlib
+
+        eng = self.engine
+        with self._lock:
+            self._ids += 1
+            bid = self._ids
+        # the batch dispatch is bounded by its LONGEST member budget:
+        # shorter members shed typed on their own event wait while
+        # the batch completes for the rest
+        dbudget = DeadlineBudget(
+            max(DeadlineBudget.MIN_WAIT_S,
+                *(e.budget.total_s - e.budget.elapsed()
+                  for e in batch))
+        )
+        if not eng._inflight.acquire(timeout=dbudget.remaining()):
+            for e in batch:
+                e.box["timeout_phase"] = "queued"
+                e.event.set()
+            return
+        try:
+            for e in batch:
+                e.held_s = e.budget.elapsed()
+            t0 = monotonic()
+            all_c = np.concatenate([e.cq for e in batch])
+            all_x = np.concatenate([e.xq for e in batch])
+            # packing-invariant noise identity: each row carries its
+            # owning request's seed and its index WITHIN that request
+            all_rs = np.concatenate([
+                np.full(e.n, e.seed & 0xFFFFFFFF, np.uint32)
+                for e in batch
+            ])
+            all_ri = np.concatenate([
+                np.arange(e.n, dtype=np.int32) for e in batch
+            ])
+            total = int(all_c.shape[0])
+            log = eng.run_log
+            span = (
+                log.span(
+                    "coalesce", batch=bid,
+                    n_requests=len(batch), rows=total,
+                    request_ids=[e.rid for e in batch],
+                    held_s=[round(e.held_s, 6) for e in batch],
+                )
+                if log is not None else contextlib.nullcontext()
+            )
+            pq_parts, ps_parts, mask_parts, used = [], [], [], []
+            with span:
+                for lo, hi, u in slice_plan(total, eng.buckets):
+                    if dbudget.expired():
+                        raise RequestTimeoutError(
+                            f"coalesce{bid}", "dispatch",
+                            dbudget.total_s,
+                        )
+                    used.append(u)
+                    pqp, psp, maskp = eng._dispatch_slice_rows(
+                        all_c[lo:hi], all_x[lo:hi],
+                        all_rs[lo:hi], all_ri[lo:hi],
+                        u, f"coalesce{bid}/bucket{u}", dbudget,
+                    )
+                    pq_parts.append(pqp)
+                    mask_parts.append(maskp)
+                    if psp is not None:
+                        ps_parts.append(psp)
+            self._walls.append(monotonic() - t0)
+            pq_all = np.concatenate(pq_parts, axis=1)
+            mask_all = np.concatenate(mask_parts)
+            ps_all = (
+                np.concatenate(ps_parts, axis=1) if ps_parts else None
+            )
+            buckets = tuple(used)
+            # scatter rows back per request, each with ITS OWN
+            # quarantine mask slice — one member's poisoned rows
+            # never touch another's
+            off = 0
+            for e in batch:
+                sl = slice(off, off + e.n)
+                e.box["result"] = (
+                    pq_all[:, sl],
+                    mask_all[sl],
+                    ps_all[:, sl] if ps_all is not None else None,
+                    buckets,
+                )
+                off += e.n
+            with self._lock:
+                self._stats["batches"] += 1
+                self._stats["requests"] += len(batch)
+                self._stats["rows"] += total
+                self._stats["max_batch_requests"] = max(
+                    self._stats["max_batch_requests"], len(batch)
+                )
+                self._stats["held_s_max"] = max(
+                    [self._stats["held_s_max"]]
+                    + [e.held_s for e in batch]
+                )
+            if log is not None:
+                log.counter("coalesce_batches", 1)
+                log.counter("coalesced_requests", len(batch))
+                log.counter("coalesced_rows", total)
+        except RequestTimeoutError as exc:
+            for e in batch:
+                e.box["timeout_phase"] = exc.phase
+        except BaseException as exc:
+            for e in batch:
+                e.box["exc"] = exc
+        finally:
+            eng._inflight.release()
+            for e in batch:
+                e.event.set()
+
+    # -- completion --------------------------------------------------
+
+    def _finish(self, entry: _Pending):
+        from smk_tpu.serve.engine import PredictResponse
+
+        box = entry.box
+        if "timeout_phase" in box and "result" not in box:
+            raise RequestTimeoutError(
+                entry.rid, box["timeout_phase"], entry.budget.total_s
+            )
+        if "exc" in box:
+            raise box["exc"]
+        pq, mask, ps, buckets = box["result"]
+        rows_degraded = ~mask
+        eng = self.engine
+        eng._note_guard(int(rows_degraded.sum()))
+        eng._count("requests_served")
+        return PredictResponse(
+            p_quant=pq,
+            rows_degraded=rows_degraded,
+            p_samples=ps,
+            buckets=buckets,
+            request_id=entry.rid,
+            latency_s=entry.budget.elapsed(),
+            held_s=entry.held_s,
+        )
+
+    def stats_snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self._stats)
+        out["window_ms"] = self.window_s * 1000.0
+        out["dispatch_estimate_s"] = self.dispatch_estimate_s()
+        return out

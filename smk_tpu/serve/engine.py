@@ -38,6 +38,19 @@ with nested ``bucket`` → ``dispatch`` → ``guard`` spans (the span
 tree is serialized under the in-flight gate; with ``max_in_flight >
 1`` concurrent requests' spans may interleave parents — latency
 numbers stay exact, the tree is best-effort).
+
+**Cross-request coalescing** (ISSUE 16): with ``coalesce_window_ms >
+0`` the engine routes admitted requests through
+:class:`~smk_tpu.serve.coalesce.RequestCoalescer`, which holds each
+request up to the window (never past its deadline budget) to pack
+concurrent requests' query rows into ONE padded ladder dispatch.
+Coalesced dispatches run a PACKING-INVARIANT program variant
+(``serve_predict_rs``) whose composition noise is derived per row
+from the owning request's ``(seed, row index)`` — so coalesced and
+per-request results are bit-identical within the coalescing mode.
+``coalesce_window_ms = 0`` (the default) is byte-identical to the
+pre-coalescer engine: same code path, same ``serve_predict`` program
+keys, zero extra programs built.
 """
 
 from __future__ import annotations
@@ -97,7 +110,11 @@ class PredictResponse(NamedTuple):
     (S, n, q) only when the engine was built with
     ``include_samples=True``. ``buckets``: the ladder buckets each
     micro-batch slice dispatched through. ``latency_s``: admission
-    to response."""
+    to response — under coalescing this INCLUDES the held interval,
+    which is also reported separately as ``held_s`` (admission to
+    batch dispatch; 0.0 on the per-request path) so the deadline
+    contract ``held_s + dispatch <= deadline`` stays auditable per
+    response."""
 
     p_quant: np.ndarray
     rows_degraded: np.ndarray
@@ -105,6 +122,7 @@ class PredictResponse(NamedTuple):
     buckets: tuple
     request_id: str
     latency_s: float
+    held_s: float = 0.0
 
     @property
     def degraded(self) -> bool:
@@ -141,6 +159,11 @@ class PredictionEngine:
     BENCH_SERVE rung.
     ``run_log_dir``: arm the PR 9 run log (one serve-session log,
     request spans nested under it).
+    ``coalesce_window_ms``: > 0 arms cross-request coalescing (the
+    :class:`~smk_tpu.serve.coalesce.RequestCoalescer` admission
+    stage; mirrors ``SMKConfig.coalesce_window_ms``). 0 — the
+    default — keeps the per-request dispatch path byte-identical to
+    the pre-coalescer engine.
     """
 
     def __init__(
@@ -151,6 +174,7 @@ class PredictionEngine:
         max_queue: int = 16,
         max_in_flight: int = 1,
         default_deadline_s: float = 30.0,
+        coalesce_window_ms: float = 0.0,
         degraded_threshold: int = DEFAULT_DEGRADED_THRESHOLD,
         compile_store_dir: Optional[str] = None,
         run_log_dir: Optional[str] = None,
@@ -198,6 +222,10 @@ class PredictionEngine:
             "requests_rejected": 0,
             "requests_degraded": 0,
             "rows_degraded": 0,
+            # padded ladder dispatches issued (one per micro-batch
+            # slice) — the coalescing amortization signal: under
+            # coalescing this runs STRICTLY below the request count
+            "dispatches": 0,
         }
         if pipeline_stats is None:
             from smk_tpu.utils.tracing import ChunkPipelineStats
@@ -238,6 +266,19 @@ class PredictionEngine:
                 artifact.coords_test,
             )
         )
+        self.coalesce_window_ms = float(coalesce_window_ms)
+        if self.coalesce_window_ms < 0:
+            raise ValueError(
+                "coalesce_window_ms must be >= 0 (0 disables "
+                "cross-request coalescing)"
+            )
+        self._coalescer = None
+        if self.coalesce_window_ms > 0:
+            from smk_tpu.serve.coalesce import RequestCoalescer
+
+            self._coalescer = RequestCoalescer(
+                self, window_s=self.coalesce_window_ms / 1000.0
+            )
         if warm:
             self.warm()
 
@@ -338,6 +379,91 @@ class PredictionEngine:
         )
         return pred, guard
 
+    # -- packing-invariant row-seed variant (ISSUE 16) ---------------
+
+    def _predict_rows_key(self, u: int) -> tuple:
+        a = self.artifact
+        return (
+            "serve_predict_rs", int(u), a.n_draws, a.n_anchor, a.q,
+            a.p, a.coord_dim, str(self._dtype), a.cov_model, a.link,
+            a.serve_digest(),
+        )
+
+    def _build_predict_rows(self, u: int):
+        import jax
+
+        from smk_tpu.api import _krige_predict_core
+        from smk_tpu.ops.quantiles import credible_summary
+
+        a = self.artifact
+        s, q = a.n_draws, a.q
+        cov_model, link = a.cov_model, a.link
+        var_floor = a.var_floor()
+
+        def fn(chol_tt, w_test, betas, phi, coords_test,
+               coords_q, x_q, row_seed, row_idx):
+            # PACKING-INVARIANT noise: each query row's composition
+            # draw derives from ITS OWN (request seed, row index)
+            # pair — fold_in of the owning request's seed by the
+            # row's index WITHIN that request — so the draw a row
+            # receives cannot depend on where the coalescer packed
+            # it. Coalesced and per-request dispatches through this
+            # program are bit-identical by construction. (The scalar
+            # -seed "serve_predict" program draws noise by POSITION
+            # in the padded bucket, which is why coalescing gets its
+            # own program kind instead of reusing it.)
+            def row_eps(rs, ri):
+                k = jax.random.fold_in(jax.random.key(rs), ri)
+                return jax.random.normal(k, (s, q), w_test.dtype)
+
+            eps = jax.vmap(row_eps, out_axes=1)(row_seed, row_idx)
+            ps = _krige_predict_core(
+                chol_tt, w_test, betas, phi, coords_test,
+                coords_q, x_q, eps,
+                cov_model=cov_model, link=link, var_floor=var_floor,
+            )
+            pq = credible_summary(ps.reshape(s, -1)).reshape(3, u, q)
+            return ps, pq
+
+        return jax.jit(fn)
+
+    def _lower_args_rows(self, u: int):
+        import jax
+
+        sd = jax.ShapeDtypeStruct
+        # same operands as the scalar-seed program, with the trailing
+        # () seed replaced by per-row (seed, index) vectors
+        return self._lower_args(u)[:-1] + (
+            sd((u,), np.uint32), sd((u,), np.int32),
+        )
+
+    def _programs_rows(self, u: int):
+        """(predict, guard) for bucket ``u`` in the packing-invariant
+        row-seed variant. The guard is the SAME program as the
+        per-request path (its input shape (S, u, q) is unchanged), so
+        arming coalescing adds exactly one extra predict program per
+        bucket to the store."""
+        import jax
+
+        from smk_tpu.compile.programs import get_program
+
+        pred = get_program(
+            self, self._predict_rows_key(u),
+            lambda: self._build_predict_rows(u),
+            store=self._store, lower_args=self._lower_args_rows(u),
+            stats=self.pstats,
+        )
+        a = self.artifact
+        guard = get_program(
+            self, self._guard_key(u), lambda: self._build_guard(u),
+            store=self._store,
+            lower_args=(jax.ShapeDtypeStruct(
+                (a.n_draws, u, a.q), self._dtype
+            ),),
+            stats=self.pstats,
+        )
+        return pred, guard
+
     def warm(self) -> dict:
         """AOT-compile (or L2-load) every ladder bucket's predict and
         guard program, then run ONE throwaway dispatch on the
@@ -369,6 +495,28 @@ class PredictionEngine:
             worker, budget, label="warmup", phase="dispatch",
             run_log=self.run_log,
         )
+        if self._coalescer is not None:
+            # coalescing dispatches through the row-seed variant —
+            # warm it too (same guard programs, one extra predict
+            # program per bucket) so a coalesced first request
+            # touches nothing cold
+            for u in self.buckets:
+                self._programs_rows(u)
+            predr, _ = self._programs_rows(u0)
+
+            def worker_rows():
+                ps, pq = _invoke_program(
+                    predr, self._predict_rows_key(u0), *self._const,
+                    coords_q, x_q,
+                    np.zeros(u0, np.uint32), np.zeros(u0, np.int32),
+                )
+                mask = _invoke_program(guard, self._guard_key(u0), ps)
+                return np.asarray(mask)
+
+            run_under_deadline(
+                worker_rows, budget, label="warmup_rs",
+                phase="dispatch", run_log=self.run_log,
+            )
         self._warm = True
         if self.run_log is not None:
             self.run_log.event(
@@ -455,6 +603,35 @@ class PredictionEngine:
         if not self._queue_sem.acquire(blocking=False):  # smklint: disable=SMK111 -- blocking=False is a zero-wait poll: the shed path must reject IMMEDIATELY, which is stricter than any timeout
             self._count("requests_shed")
             raise QueueFullError(self.max_queue)
+        if self._coalescer is not None:
+            # coalesced admission (ISSUE 16): the request keeps its
+            # waiting-room slot for the whole held+dispatch interval
+            # (the coalescing window IS a waiting room) and the batch
+            # leader acquires the in-flight gate on behalf of the
+            # whole batch inside serve/coalesce.py. The request span
+            # covers submit -> response on the caller thread, so the
+            # batch leader's `coalesce` span nests under ITS request
+            # span while followers' spans show pure held time
+            import contextlib
+
+            span = (
+                self.run_log.span(
+                    "request", id=rid, n=int(cq.shape[0]),
+                    coalesced=True,
+                )
+                if self.run_log is not None
+                else contextlib.nullcontext()
+            )
+            try:
+                with span:
+                    return self._coalescer.submit(
+                        cq, xq, rid, int(seed), budget
+                    )
+            except RequestTimeoutError:
+                self._count("requests_timed_out")
+                raise
+            finally:
+                self._queue_sem.release()
         try:
             got = self._inflight.acquire(timeout=budget.remaining())
             if not got:
@@ -571,6 +748,7 @@ class PredictionEngine:
             log.span("dispatch", bucket=u)
             if log is not None else contextlib.nullcontext()
         )
+        self._count("dispatches")
         with dspan:
             ps, pq = run_under_deadline(
                 dispatch_worker, budget, label=label,
@@ -605,6 +783,79 @@ class PredictionEngine:
             mask[:n_sl],
         )
 
+    def _dispatch_slice_rows(
+        self, sl_c, sl_x, sl_rs, sl_ri, u, label, budget
+    ):
+        """One COALESCED micro-batch slice through its bucket via the
+        packing-invariant row-seed program: pad → dispatch → guard,
+        every device wait under the batch deadline (the same SMK114
+        discipline as :meth:`_dispatch_slice`). Pad rows repeat the
+        slice's first entry — coords, seed and index alike —
+        guaranteed-finite content that is sliced away before
+        scatter-back."""
+        import contextlib
+
+        log = self.run_log
+        n_sl = sl_c.shape[0]
+        pad = u - n_sl
+        if pad:
+            sl_c = np.concatenate(
+                [sl_c, np.repeat(sl_c[:1], pad, axis=0)]
+            )
+            sl_x = np.concatenate(
+                [sl_x, np.zeros((pad,) + sl_x.shape[1:], sl_x.dtype)]
+            )
+            sl_rs = np.concatenate([sl_rs, np.repeat(sl_rs[:1], pad)])
+            sl_ri = np.concatenate([sl_ri, np.repeat(sl_ri[:1], pad)])
+        pred, guard = self._programs_rows(u)
+        pkey, gkey = self._predict_rows_key(u), self._guard_key(u)
+        const = self._const
+        sl_c = sl_c.astype(self._dtype, copy=False)
+        sl_x = sl_x.astype(self._dtype, copy=False)
+        sl_rs = np.ascontiguousarray(sl_rs, dtype=np.uint32)
+        sl_ri = np.ascontiguousarray(sl_ri, dtype=np.int32)
+
+        def dispatch_worker():
+            return _invoke_program(
+                pred, pkey, *const, sl_c, sl_x, sl_rs, sl_ri
+            )
+
+        dspan = (
+            log.span("dispatch", bucket=u, coalesced=True)
+            if log is not None else contextlib.nullcontext()
+        )
+        self._count("dispatches")
+        with dspan:
+            ps, pq = run_under_deadline(
+                dispatch_worker, budget, label=label,
+                phase="dispatch", run_log=log,
+            )
+
+        include_samples = self.include_samples
+
+        def guard_worker():
+            mask = np.asarray(_invoke_program(guard, gkey, ps))
+            # response D2H inside the deadline, as on the per-request
+            # path: the fetch is where a wedged device surfaces
+            pq_np = np.asarray(pq)
+            ps_np = np.asarray(ps) if include_samples else None
+            return mask, pq_np, ps_np
+
+        gspan = (
+            log.span("guard", bucket=u, coalesced=True)
+            if log is not None else contextlib.nullcontext()
+        )
+        with gspan:
+            mask, pq_np, ps_np = run_under_deadline(
+                guard_worker, budget, label=label,
+                phase="guard", run_log=log,
+            )
+        return (
+            pq_np[:, :n_sl],
+            ps_np[:, :n_sl] if ps_np is not None else None,
+            mask[:n_sl],
+        )
+
     # -- health ----------------------------------------------------
 
     def health(self) -> dict:
@@ -620,6 +871,9 @@ class PredictionEngine:
             out["buckets"] = list(self.buckets)
             out["max_queue"] = self.max_queue
             out["max_in_flight"] = self.max_in_flight
+            out["coalesce_window_ms"] = self.coalesce_window_ms
+        if self._coalescer is not None:
+            out["coalesce"] = self._coalescer.stats_snapshot()
         return out
 
     def drain(self) -> None:

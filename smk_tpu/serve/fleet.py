@@ -1,0 +1,251 @@
+"""Replica fleet: shared-store scale-out of the prediction engine
+(ISSUE 16).
+
+PR 11's topology-fingerprinted L2 store makes extra engine replicas
+essentially free: every :class:`~smk_tpu.serve.engine.
+PredictionEngine` pointed at one warm ``compile_store_dir``
+deserializes the same executables — a fleet spins up with ZERO XLA
+backend compiles per replica (``recompile_guard(0)``-pinned in
+SERVE_LOAD_r17.jsonl). This module is the shedding front door over N
+such replicas in one process:
+
+- **Routing**: round-robin over replicas, falling through to the
+  next replica when one's bounded waiting room is full — per-replica
+  admission control (``QueueFullError``) becomes fleet-level load
+  balancing for free.
+- **Shedding**: when EVERY replica sheds, the fleet raises a typed
+  :class:`FleetSaturatedError` (a ``QueueFullError`` subclass, so
+  existing per-engine retry logic keeps working) — overload degrades
+  into fast rejections, never an unbounded queue (SMK111; every
+  fall-through is a zero-wait poll against an already-bounded room).
+- **Health**: :meth:`ReplicaFleet.health` aggregates the replicas'
+  states (ready while any replica is ready) plus summed admission
+  counters, for the same external probes the single engine serves.
+
+The fleet shares ONE artifact object across replicas (device
+constants are put per replica — that is the point of a replica) and
+forwards every engine knob, including ``coalesce_window_ms``: a
+coalescing fleet batches within each replica while the front door
+spreads load across them.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Optional
+
+from smk_tpu.serve.artifact import FitArtifact, load_artifact
+from smk_tpu.serve.engine import (
+    EngineDrainingError,
+    PredictionEngine,
+    PredictResponse,
+    QueueFullError,
+)
+
+
+class FleetSaturatedError(QueueFullError):
+    """Every replica's bounded waiting room is full — the request is
+    shed IMMEDIATELY at the fleet front door (typed; subclasses
+    :class:`QueueFullError` so per-engine backoff logic applies
+    unchanged)."""
+
+    def __init__(self, n_replicas: int, max_queue: int):
+        self.n_replicas = int(n_replicas)
+        self.max_queue = int(max_queue)
+        RuntimeError.__init__(
+            self,
+            f"all {n_replicas} replicas shed ({max_queue} waiting "
+            "each) — request shed at the fleet front door; retry "
+            "with backoff or raise n_replicas/max_queue"
+        )
+
+
+class ReplicaFleet:
+    """N engine replicas behind one shedding front door.
+
+    ``artifact``: a :class:`FitArtifact` or a path (loaded ONCE and
+    shared). ``n_replicas``: engine count (threads in this process).
+    ``run_log_dir``: the FLEET's own run log (``replica`` spans for
+    spin-up, ``replica_shed``/``fleet_saturated`` events, routing
+    counters) — per-replica logs are deliberately not opened here;
+    pass nothing and read the fleet log. Every other keyword is
+    forwarded verbatim to each :class:`PredictionEngine` — point
+    ``compile_store_dir`` at a warm store and no replica compiles.
+    """
+
+    def __init__(
+        self,
+        artifact,
+        *,
+        n_replicas: int = 2,
+        run_log_dir: Optional[str] = None,
+        **engine_kwargs,
+    ):
+        if int(n_replicas) < 1:
+            raise ValueError("n_replicas must be >= 1")
+        self.n_replicas = int(n_replicas)
+        if isinstance(artifact, (str, bytes)) or hasattr(
+            artifact, "__fspath__"
+        ):
+            artifact = load_artifact(artifact)
+        if not isinstance(artifact, FitArtifact):
+            raise TypeError(
+                "artifact must be a FitArtifact or a path to one"
+            )
+        self.artifact = artifact
+        self.run_log = None
+        if run_log_dir:
+            from smk_tpu.obs.events import open_run_log
+
+            self.run_log = open_run_log(
+                run_log_dir, name="fleet",
+                meta={
+                    "n_replicas": self.n_replicas,
+                    "config_digest": artifact.config_digest,
+                },
+            )
+        self._lock = threading.Lock()
+        self._ids = itertools.count()
+        self._rr = itertools.count()
+        self._stats = {
+            "requests_routed": 0,
+            "requests_shed_fleet": 0,
+            "replica_fallthroughs": 0,
+        }
+        import contextlib
+
+        self._engines = []
+        for i in range(self.n_replicas):
+            span = (
+                self.run_log.span("replica", replica=i)
+                if self.run_log is not None
+                else contextlib.nullcontext()
+            )
+            with span:
+                eng = PredictionEngine(artifact, **engine_kwargs)
+            self._engines.append(eng)
+            if self.run_log is not None:
+                self.run_log.event(
+                    "replica", replica=i, action="up",
+                    sources=eng.program_summary(),
+                )
+
+    @property
+    def engines(self) -> tuple:
+        return tuple(self._engines)
+
+    def _count(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            self._stats[field] += n
+
+    # -- front door --------------------------------------------------
+
+    def predict(
+        self,
+        coords_query,
+        x_query,
+        *,
+        deadline_s: Optional[float] = None,
+        seed: int = 0,
+        request_id: Optional[str] = None,
+    ) -> PredictResponse:
+        """Route one request to the first replica (round-robin start)
+        whose waiting room admits it; all-shed raises the typed
+        :class:`FleetSaturatedError`, all-draining re-raises
+        :class:`EngineDrainingError`. Same determinism contract as
+        the engine: results depend on (artifact, query, seed), never
+        on which replica served."""
+        rid = request_id or f"f{next(self._ids)}"
+        start = next(self._rr) % self.n_replicas
+        draining = 0
+        for k in range(self.n_replicas):
+            idx = (start + k) % self.n_replicas
+            eng = self._engines[idx]
+            try:
+                resp = eng.predict(
+                    coords_query, x_query, deadline_s=deadline_s,
+                    seed=seed, request_id=rid,
+                )
+            except QueueFullError:
+                # zero-wait per-replica shed — fall through to the
+                # next replica, never wait on a full room
+                self._count("replica_fallthroughs")
+                if self.run_log is not None:
+                    self.run_log.event(
+                        "replica", replica=idx, action="shed",
+                        request_id=rid,
+                    )
+                continue
+            except EngineDrainingError:
+                draining += 1
+                continue
+            self._count("requests_routed")
+            if self.run_log is not None:
+                self.run_log.counter("fleet_requests_routed", 1)
+            return resp
+        if draining == self.n_replicas:
+            raise EngineDrainingError(
+                "all replicas draining — no new requests"
+            )
+        self._count("requests_shed_fleet")
+        if self.run_log is not None:
+            self.run_log.event(
+                "fleet_saturated", request_id=rid,
+                n_replicas=self.n_replicas,
+            )
+            self.run_log.counter("fleet_requests_shed", 1)
+        raise FleetSaturatedError(
+            self.n_replicas, self._engines[0].max_queue
+        )
+
+    # -- health / lifecycle -------------------------------------------
+
+    def health(self) -> dict:
+        """Fleet-level snapshot: ``state`` is "ready" while ANY
+        replica is ready, "draining" when all are, else "degraded";
+        per-replica snapshots ride along and the admission counters
+        are summed across replicas."""
+        reps = [e.health() for e in self._engines]
+        states = [r["state"] for r in reps]
+        if any(s == "ready" for s in states):
+            state = "ready"
+        elif all(s == "draining" for s in states):
+            state = "draining"
+        else:
+            state = "degraded"
+        summed: dict = {}
+        for r in reps:
+            for k, v in r.items():
+                if isinstance(v, (int, float)) and not isinstance(
+                    v, bool
+                ):
+                    summed[k] = summed.get(k, 0) + v
+        summed.pop("coalesce_window_ms", None)
+        with self._lock:
+            out = dict(self._stats)
+        out.update(
+            state=state,
+            ready=state == "ready",
+            n_replicas=self.n_replicas,
+            replicas=reps,
+            totals=summed,
+        )
+        return out
+
+    def drain(self) -> None:
+        for eng in self._engines:
+            eng.drain()
+
+    def close(self) -> None:
+        for eng in self._engines:
+            eng.close()
+        if self.run_log is not None:
+            self.run_log.close(fleet=self.health())
+            self.run_log = None
+
+    def __enter__(self) -> "ReplicaFleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -600,11 +600,15 @@ def _ensure_serve_patched() -> None:
         real = _serve_engine._invoke_program
 
         def invoking(prog, prog_key, *args):
-            # wrap ONLY predict dispatches (never the guard — the
-            # guard must observe the damage), ONLY while armed
+            # wrap ONLY predict dispatches — both the scalar-seed
+            # per-request program and the coalescer's row-seed
+            # variant (ISSUE 16) — never the guard (the guard must
+            # observe the damage), ONLY while armed
             if (
                 not (_active_predict_stall or _active_predict_nan)
-                or prog_key[0] != "serve_predict"
+                or prog_key[0] not in (
+                    "serve_predict", "serve_predict_rs"
+                )
             ):
                 return real(prog, prog_key, *args)
             # fire-count check-and-increment under the arm lock:

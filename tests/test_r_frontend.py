@@ -600,3 +600,45 @@ class TestServePassThrough:
         assert "rows.degraded = as.logical(to_r(res$rows_degraded))" \
             in r_src
         assert "health = eng$health()" in r_src
+
+    def test_coalesce_and_fleet_wired(self):
+        """The ISSUE 16 front-end additions: R ``coalesce.window.ms``
+        must feed ``PredictionEngine(coalesce_window_ms=...)``,
+        ``n.replicas`` must route construction through
+        ``serve$ReplicaFleet``, both must ride the engine cache key
+        (different serving topology = different engine object), and
+        the response must surface ``held.s`` (source-checked — the
+        coalescer/fleet are exercised end-to-end in
+        tests/test_serve.py)."""
+        import os
+
+        r_src = open(
+            os.path.join(
+                os.path.dirname(os.path.dirname(__file__)),
+                "r", "meta_kriging_tpu.R",
+            )
+        ).read()
+        assert "coalesce.window.ms = NULL" in r_src
+        assert "n.replicas = NULL" in r_src
+        assert "coalesce_window_ms <- coalesce.window.ms" in r_src
+        assert "serve$ReplicaFleet" in r_src
+        assert "n_replicas <- as.integer(n.replicas)" in r_src
+        # both knobs ride eng_key: a window/replica change must build
+        # a fresh engine, never reuse the cached single-engine object
+        assert (
+            'if (is.null(coalesce.window.ms)) 0 else '
+            'coalesce.window.ms' in r_src
+        )
+        assert 'if (is.null(n.replicas)) 1 else n.replicas' in r_src
+        assert "held.s = res$held_s" in r_src
+
+    def test_coalesce_window_config_validation(self):
+        """SMKConfig-side contract the R knob rides on: the float
+        field exists, defaults to 0 (off), and rejects negatives."""
+        import smk_tpu as smk
+
+        assert smk.SMKConfig().coalesce_window_ms == 0.0
+        cfg = smk.SMKConfig(coalesce_window_ms=25.0)
+        assert cfg.coalesce_window_ms == 25.0
+        with pytest.raises(ValueError, match="coalesce_window_ms"):
+            smk.SMKConfig(coalesce_window_ms=-1.0)

@@ -8,8 +8,12 @@ factor-reuse regression (predict call 2 performs ZERO m-sized
 factorizations), query validation, bucket-ladder selection incl. the
 pad-row identity, queue shedding, deadline math, degraded
 partial-response masks with bitwise-healthy rows, health-state
-transitions, and the request span tree. Heavy concurrency legs are
-slow-marked.
+transitions, and the request span tree. ISSUE 16 legs ride the same
+fixtures: cross-request coalescing (bit-identity vs per-request
+dispatch, deadline-critical flush, per-request quarantine scatter,
+held_s accounting) and the replica fleet (round-robin,
+zero-compile spin-up on the warm store, typed saturation). Heavy
+concurrency legs are slow-marked.
 """
 
 # smklint: test-budget=one shared m=16 fit (~14 s) + one serve program set (~4 s) module-wide; every test after the fixtures measures milliseconds
@@ -31,8 +35,10 @@ from smk_tpu.serve import (
     ArtifactError,
     DeadlineBudget,
     EngineDrainingError,
+    FleetSaturatedError,
     PredictionEngine,
     QueueFullError,
+    ReplicaFleet,
     RequestTimeoutError,
     load_artifact,
     run_under_deadline,
@@ -604,3 +610,311 @@ class TestConcurrencySlow:
         for r in out:
             np.testing.assert_array_equal(r.p_quant, ref.p_quant)
         assert eng.health()["requests_served"] == 33
+
+
+# -- ISSUE 16: cross-request coalescing -------------------------------
+
+# short real window: long enough that three threads started back to
+# back land in ONE batch, short enough that serial requests (each
+# paying the full window alone) stay milliseconds
+_WINDOW_MS = 150.0
+
+
+@pytest.fixture(scope="module")
+def ceng(artifact_path, serve_dirs, engine):
+    """The module's ONE window-armed engine (depends on `engine` so
+    the scalar program set is already in the shared L2 store — this
+    engine only adds the two row-seed predict programs)."""
+    eng = _fresh_engine(
+        artifact_path, serve_dirs, coalesce_window_ms=_WINDOW_MS,
+    )
+    yield eng
+    eng.close()
+
+
+class TestCoalescing:
+    def test_window_zero_default_path_untouched(self, engine):
+        """The default engine (coalesce_window_ms=0) is the PR 13
+        path: no coalescer, no row-seed programs in L1, held_s
+        pinned to 0.0 on every response."""
+        r = engine.predict(*_queries(3, seed=41))
+        assert r.held_s == 0.0
+        assert engine._coalescer is None
+        assert not any(
+            k[0] == "serve_predict_rs"
+            for k in engine.__dict__.get("_chunk_programs", {})
+        )
+        h = engine.health()
+        assert h["coalesce_window_ms"] == 0.0
+        assert "coalesce" not in h
+
+    def test_coalesced_bit_identical_and_fewer_dispatches(self, ceng):
+        """The exit-gate contract: concurrent requests coalesce into
+        STRICTLY fewer dispatches than requests, and every response
+        is bit-identical to serving the same request alone (the
+        row-seed program makes noise packing-invariant, so even a
+        different bucket size cannot change a row's draw)."""
+        reqs = [_queries(3, seed=1), _queries(2, seed=2),
+                _queries(3, seed=3)]
+        solo = [
+            ceng.predict(c, x, seed=i) for i, (c, x) in enumerate(reqs)
+        ]
+        d0 = ceng.health()["dispatches"]
+        results = [None] * len(reqs)
+        errs = []
+
+        def worker(i):
+            try:
+                c, x = reqs[i]
+                results[i] = ceng.predict(c, x, seed=i)
+            except Exception as e:  # noqa: BLE001 - recorded
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,))
+            for i in range(len(reqs))
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=30.0)
+        assert not errs
+        d_batch = ceng.health()["dispatches"] - d0
+        assert d_batch < len(reqs)  # strictly fewer dispatches
+        for i in range(len(reqs)):
+            np.testing.assert_array_equal(
+                results[i].p_quant, solo[i].p_quant
+            )
+            np.testing.assert_array_equal(
+                results[i].rows_degraded, solo[i].rows_degraded
+            )
+        co = ceng.health()["coalesce"]
+        assert co["max_batch_requests"] >= 2
+        assert co["window_ms"] == _WINDOW_MS
+
+    def test_held_s_accounting_within_deadline(self, ceng):
+        """Satellite (a): latency_s starts at ADMISSION — held time
+        is included and reported separately — and held_s + dispatch
+        never exceeds the deadline on a served request."""
+        deadline = 10.0
+        r = ceng.predict(*_queries(3, seed=5), deadline_s=deadline)
+        # a lone request's leader holds for the full window: held_s
+        # must show it, and latency_s (admission -> response) must
+        # contain it
+        assert r.held_s >= 0.5 * (_WINDOW_MS / 1000.0)
+        assert r.latency_s >= r.held_s
+        # held + dispatch <= deadline on every served request:
+        # latency_s IS held + queue + dispatch
+        assert r.latency_s <= deadline
+
+    def test_deadline_critical_request_never_held(self, ceng):
+        """A request whose headroom is already gone (remaining budget
+        < safety x dispatch estimate) skips the window outright:
+        held_s ~ 0 while looser requests keep coalescing."""
+        co = ceng._coalescer
+        crit0 = co.stats_snapshot()["critical_flushes"]
+        # white-box: plant a large observed dispatch wall so the
+        # headroom math (remaining - 2 x estimate) goes negative for
+        # this deadline without any real slow dispatch
+        co._walls.append(5.0)
+        try:
+            r = ceng.predict(*_queries(3, seed=6), deadline_s=8.0)
+        finally:
+            co._walls.clear()
+        assert r.held_s < 0.05  # never held through the 150 ms window
+        assert co.stats_snapshot()["critical_flushes"] == crit0 + 1
+        # the engine still serves fine afterwards
+        r2 = ceng.predict(*_queries(3, seed=6), deadline_s=8.0)
+        np.testing.assert_array_equal(r2.p_quant, r.p_quant)
+
+    def test_quarantine_scatter_back_isolated(self, ceng):
+        """SERVE_r15 partial-response contract PER MEMBER of a
+        coalesced batch: one poisoned padded row degrades exactly the
+        request that owns it; its batch-mates come back clean and
+        bit-identical to their solo responses."""
+        from smk_tpu.testing.faults import inject_predict_nan
+
+        reqs = [_queries(3, seed=21), _queries(2, seed=22),
+                _queries(3, seed=23)]
+        solo = [
+            ceng.predict(c, x, seed=50 + i)
+            for i, (c, x) in enumerate(reqs)
+        ]
+        assert not any(r.rows_degraded.any() for r in solo)
+        d0 = ceng.health()["dispatches"]
+        results = [None] * len(reqs)
+        errs = []
+        gate = threading.Barrier(len(reqs))
+
+        def worker(i):
+            try:
+                gate.wait(timeout=10.0)
+                c, x = reqs[i]
+                results[i] = ceng.predict(c, x, seed=50 + i)
+            except Exception as e:  # noqa: BLE001 - recorded
+                errs.append(e)
+
+        # padded row 1 of the ONE coalesced dispatch belongs to the
+        # first-arrived member's local row 1 (every member has >= 2
+        # rows), whichever member that is
+        with inject_predict_nan(rows=[1], max_fires=1) as inj:
+            threads = [
+                threading.Thread(target=worker, args=(i,))
+                for i in range(len(reqs))
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=30.0)
+        assert not errs
+        assert inj.fires == 1
+        # all eight rows went out as ONE dispatch — the injection hit
+        # the coalesced batch, not a solo request
+        assert ceng.health()["dispatches"] - d0 == 1
+        degraded = [
+            i for i, r in enumerate(results) if r.rows_degraded.any()
+        ]
+        assert len(degraded) == 1
+        hurt = results[degraded[0]]
+        assert hurt.degraded and hurt.rows_degraded[1]
+        assert int(hurt.rows_degraded.sum()) == 1
+        # healthy rows of the hurt member are bitwise-identical too
+        healthy = ~hurt.rows_degraded
+        np.testing.assert_array_equal(
+            hurt.p_quant[:, healthy],
+            solo[degraded[0]].p_quant[:, healthy],
+        )
+        # batch-mates: untouched, full solo bit-identity
+        for i, r in enumerate(results):
+            if i == degraded[0]:
+                continue
+            assert not r.rows_degraded.any()
+            np.testing.assert_array_equal(
+                r.p_quant, solo[i].p_quant
+            )
+        # zero residue on the next coalesced-path request
+        again = ceng.predict(*_queries(3, seed=21), seed=50)
+        assert not again.rows_degraded.any()
+
+
+# -- ISSUE 16: replica fleet ------------------------------------------
+
+
+class TestReplicaFleet:
+    def test_round_robin_zero_compile_warm(
+        self, artifact_path, serve_dirs, engine
+    ):
+        """N replicas on the module's warm store spin up with ZERO
+        XLA backend compiles (the L2 store is the point of the
+        fleet), round-robin requests across replicas, and return
+        replica-independent bit-identical results."""
+        from smk_tpu.analysis.sanitizers import recompile_guard
+
+        _, store = serve_dirs
+        with recompile_guard(0, "fleet spin-up on warm store"):
+            fleet = ReplicaFleet(
+                artifact_path, n_replicas=2, buckets=(4, 8),
+                compile_store_dir=store, default_deadline_s=30.0,
+            )
+        try:
+            cq, xq = _queries(3, seed=61)
+            r1 = fleet.predict(cq, xq, seed=1)
+            r2 = fleet.predict(cq, xq, seed=1)
+            np.testing.assert_array_equal(r1.p_quant, r2.p_quant)
+            h = fleet.health()
+            assert h["state"] == "ready" and h["n_replicas"] == 2
+            assert h["requests_routed"] == 2
+            assert h["totals"]["requests_served"] == 2
+            # round-robin: one request per replica
+            assert [
+                rep["requests_served"] for rep in h["replicas"]
+            ] == [1, 1]
+        finally:
+            fleet.close()
+
+    def test_all_shed_raises_typed_saturation(
+        self, artifact_path, serve_dirs, engine
+    ):
+        """When EVERY replica sheds, the front door raises the typed
+        FleetSaturatedError (a QueueFullError subclass) after one
+        zero-wait fall-through per replica."""
+        _, store = serve_dirs
+        fleet = ReplicaFleet(
+            artifact_path, n_replicas=2, buckets=(4, 8),
+            compile_store_dir=store, default_deadline_s=30.0,
+        )
+        try:
+            def shed(*a, **k):
+                raise QueueFullError(1)
+
+            for eng in fleet.engines:
+                eng.predict = shed
+            with pytest.raises(FleetSaturatedError) as ei:
+                fleet.predict(*_queries(3, seed=62))
+            assert isinstance(ei.value, QueueFullError)
+            assert ei.value.n_replicas == 2
+            h = fleet.health()
+            assert h["requests_shed_fleet"] == 1
+            assert h["replica_fallthroughs"] == 2
+        finally:
+            fleet.close()
+
+    def test_drain_all_replicas_typed(
+        self, artifact_path, serve_dirs, engine
+    ):
+        _, store = serve_dirs
+        fleet = ReplicaFleet(
+            artifact_path, n_replicas=2, buckets=(4, 8),
+            compile_store_dir=store, default_deadline_s=30.0,
+        )
+        try:
+            fleet.drain()
+            assert fleet.health()["state"] == "draining"
+            with pytest.raises(EngineDrainingError):
+                fleet.predict(*_queries(3, seed=63))
+        finally:
+            fleet.close()
+
+
+# -- ISSUE 16: serve summarize block ----------------------------------
+
+
+class TestServeSummarizeBlock:
+    def test_coalesce_spans_feed_summary(
+        self, artifact_path, serve_dirs, tmp_path
+    ):
+        """The run-log summarizer's serve block: coalesce spans carry
+        batch occupancy + per-request held_s, and the run_end serve
+        stats feed the shed counters."""
+        from smk_tpu.obs.summarize import summarize
+
+        eng = _fresh_engine(
+            artifact_path, serve_dirs,
+            coalesce_window_ms=_WINDOW_MS,
+            run_log_dir=str(tmp_path / "rlog"),
+        )
+        results = [None, None]
+
+        def worker(i):
+            results[i] = eng.predict(*_queries(3, seed=70 + i), seed=i)
+
+        threads = [
+            threading.Thread(target=worker, args=(i,))
+            for i in range(2)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=30.0)
+        path = eng.run_log.path
+        eng.close()
+        assert all(r is not None for r in results)
+        s = summarize(path)["serve"]
+        assert s["n_request_spans"] == 2
+        assert s["coalesce"]["n_batches"] >= 1
+        assert s["coalesce"]["requests"] == 2
+        assert s["coalesce"]["rows"] == 6
+        assert s["held_s_max"] is not None
+        assert sum(s["held_s_hist"].values()) == 2
+        assert s["sheds"]["requests_served"] == 2
+        assert s["sheds"]["requests_shed"] == 0

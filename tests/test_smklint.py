@@ -1274,10 +1274,99 @@ class TestLadderDiscipline:
         assert "SMK115" in rules_hit(broken, path=real)
 
 
+COALESCE_PATH = "smk_tpu/serve/coalesce.py"
+FLEET_PATH = "smk_tpu/serve/fleet.py"
+
+
+class TestBoundedCoalesceWait:
+    """SMK116 (ISSUE 16): the coalescer/fleet hot path holds OTHER
+    requests' latency budgets while it waits — sleeps are banned and
+    wait bounds must be config/budget-derived, not numeric literals."""
+
+    def test_time_sleep_flagged(self):
+        src = (
+            "import time\n"
+            "def window_hold():\n"
+            "    time.sleep(0.05)\n"
+        )
+        assert "SMK116" in rules_hit(src, path=COALESCE_PATH)
+
+    def test_from_import_sleep_alias_flagged(self):
+        src = (
+            "from time import sleep as snooze\n"
+            "def window_hold():\n"
+            "    snooze(0.05)\n"
+        )
+        assert "SMK116" in rules_hit(src, path=FLEET_PATH)
+
+    def test_literal_timeout_kwarg_flagged(self):
+        src = (
+            "def f(cv, ev, lock):\n"
+            "    cv.wait(timeout=0.1)\n"
+            "    ev.wait(timeout=5)\n"
+            "    lock.acquire(timeout=2.0)\n"
+        )
+        hits = lines_hit(src, "SMK116", path=COALESCE_PATH)
+        assert hits == [2, 3, 4]
+
+    def test_literal_positional_timeout_flagged(self):
+        src = "def f(ev):\n    ev.wait(0.25)\n"
+        assert "SMK116" in rules_hit(src, path=COALESCE_PATH)
+
+    def test_budget_derived_bounds_clean(self):
+        src = (
+            "def f(cv, ev, lock, budget, hold):\n"
+            "    cv.wait(timeout=hold)\n"
+            "    ev.wait(timeout=budget.remaining())\n"
+            "    lock.acquire(timeout=budget.remaining())\n"
+        )
+        assert "SMK116" not in rules_hit(src, path=COALESCE_PATH)
+
+    def test_bool_acquire_flag_and_string_get_clean(self):
+        # lock.acquire(True) is a blocking flag, not a timeout;
+        # box.get("key") carries a string operand
+        src = (
+            "def f(lock, box):\n"
+            "    lock.acquire(True)\n"
+            "    return box.get('result')\n"
+        )
+        assert "SMK116" not in rules_hit(src, path=COALESCE_PATH)
+
+    def test_scoped_to_coalesce_and_fleet_only(self):
+        # the same literal-timeout spelling is legal elsewhere in
+        # smk_tpu/ (SMK111 only demands a bound exists)
+        src = "def f(ev):\n    ev.wait(timeout=0.1)\n"
+        assert "SMK116" not in rules_hit(src)
+        assert "SMK116" not in rules_hit(
+            src, path="smk_tpu/serve/engine.py"
+        )
+
+    def test_suppression_with_justification(self):
+        src = (
+            "def f(ev):\n"
+            "    ev.wait(timeout=0.1)  "
+            "# smklint: disable=SMK116 -- test-only poll cadence\n"
+        )
+        hits = rules_hit(src, path=COALESCE_PATH)
+        assert "SMK116" not in hits and "SMK100" not in hits
+
+    def test_real_modules_clean_and_seeded_defect_caught(self):
+        for real in (COALESCE_PATH, FLEET_PATH):
+            src = repo_file(real)
+            assert "SMK116" not in rules_hit(src, path=real), real
+        src = repo_file(COALESCE_PATH)
+        broken = src + (
+            "\n\ndef _window_hold_naive(window_s):\n"
+            "    import time\n"
+            "    time.sleep(0.05)\n"
+        )
+        assert "SMK116" in rules_hit(broken, path=COALESCE_PATH)
+
+
 @pytest.mark.parametrize("rule_id", [
     "SMK101", "SMK102", "SMK103", "SMK104", "SMK105", "SMK106",
     "SMK107", "SMK108", "SMK109", "SMK110", "SMK111", "SMK112",
-    "SMK113", "SMK114", "SMK115",
+    "SMK113", "SMK114", "SMK115", "SMK116",
 ])
 def test_every_rule_documented_in_catalogue(rule_id):
     from smk_tpu.analysis.lint import _list_rules
