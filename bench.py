@@ -128,6 +128,14 @@ BENCH_RAGGED_ITERS resize it (scripts/ragged_probe.py is the
 subprocess-isolated compile-accounting sibling → RAGGED_r16.jsonl:
 cold ≤ one program set per occupied bucket, warm-store fresh-process
 zero compiles, exact-rung-m bit-identity, padded-vs-trimmed parity).
+BENCH_RAGGED=1 COMPOSES with BENCH_MESH=1 (ISSUE 17): the same
+clustered fit then runs under an explicit device mesh — the
+ragged-mesh planner bin-packs the occupied bucket groups onto prefix
+sub-meshes (K-pad clones / super-batch fusion) and the rung
+additionally stamps the mesh topology, the executed
+ragged_mesh_plan, and the mesh-induced pad_waste_frac
+(BENCH_MESH_DEVICES sizes the mesh; scripts/ragged_probe.py --mesh
+is the subprocess-isolated sibling → RAGGED_MESH_r18.jsonl).
 
 Synthetic latent surfaces use random Fourier features (an O(n)
 stationary GP approximation) so data generation never needs an n x n
@@ -1264,7 +1272,7 @@ def run_rung_serve_latency(name, *, solver_env=None, n=None, k=None,
 
 
 def run_rung_ragged(name, *, solver_env=None, n=None, k=None,
-                    n_samples=None, n_test=32):
+                    n_samples=None, n_test=32, n_devices=None):
     """BENCH_RAGGED=1 (ISSUE 15): the ragged-partition ladder rung.
 
     A CLUSTERED binary field (unequal-mass Gaussian blobs — the
@@ -1280,10 +1288,21 @@ def run_rung_ragged(name, *, solver_env=None, n=None, k=None,
     speed is mixing-honest. BENCH_RAGGED_N / BENCH_RAGGED_K /
     BENCH_RAGGED_ITERS resize; scripts/ragged_probe.py is the
     subprocess-isolated compile-accounting sibling
-    (RAGGED_r16.jsonl)."""
+    (RAGGED_r16.jsonl).
+
+    **Composes with BENCH_MESH=1 (ISSUE 17)**: ``n_devices`` routes
+    the SAME clustered fit through an explicit device mesh — the
+    ragged-mesh planner (compile/buckets.plan_ragged_mesh) bin-packs
+    the occupied bucket groups onto prefix sub-meshes, and the record
+    additionally stamps the mesh topology, the executed
+    ``ragged_mesh_plan``, and the mesh-induced ``pad_waste_frac``
+    next to the ladder's intra-bucket ``pad_frac``
+    (scripts/ragged_probe.py --mesh is the subprocess-isolated
+    sibling emitting RAGGED_MESH_r18.jsonl)."""
     import dataclasses
 
     from smk_tpu.api import fit_meta_kriging
+    from smk_tpu.parallel.executor import make_mesh
     from smk_tpu.parallel.partition import coherent_partition
     from smk_tpu.utils.tracing import ChunkPipelineStats
 
@@ -1330,6 +1349,12 @@ def run_rung_ragged(name, *, solver_env=None, n=None, k=None,
         ),
         partition_method="coherent",
     )
+    # BENCH_MESH composition: an explicit mesh routes the ragged fit
+    # through the bin-packing planner instead of the host group loop
+    mesh = (
+        make_mesh(n_devices, axis=cfg.mesh_axis)
+        if n_devices is not None else None
+    )
     # the partition the fit will build is a DETERMINISTIC function of
     # the coordinates (coherent_partition ignores its key), so the
     # ladder accounting can be stamped from an identical preview
@@ -1350,7 +1375,7 @@ def run_rung_ragged(name, *, solver_env=None, n=None, k=None,
     t0 = time.time()
     res = fit_meta_kriging(
         jax.random.key(2), y, x, coords, coords_test, x_test,
-        config=cfg,
+        config=cfg, mesh=mesh,
         chunk_iters=chunk_iters,
         pipeline_stats=pstats,
     )
@@ -1368,12 +1393,19 @@ def run_rung_ragged(name, *, solver_env=None, n=None, k=None,
         "rung": name,
         "n": n, "K": k, "iters": n_samples, "public_path": True,
         "partition_method": "coherent",
+        "meshed": mesh is not None,
+        **(mesh_topology_stamp(mesh) if mesh is not None else {}),
         "sizes": list(part.sizes),
         "n_distinct_sizes": len(set(part.sizes)),
         "ladder": list(part.ladder),
         "occupied_buckets": list(part.buckets),
         "pad_frac": pad["pad_frac"],
         "pad_rows": pad["pad_rows"],
+        # mesh-INDUCED waste (K-pad clones + fusion m-re-pad) from
+        # the executed plan — 0.0 on the host ragged path, where the
+        # only padding is the intra-bucket pad_frac above
+        "pad_waste_frac": res.pad_waste_frac,
+        "ragged_mesh_plan": agg.get("ragged_mesh_plan"),
         "wall_s_incl_compile": round(wall, 2),
         "fit_s": round(
             res.phase_seconds.get("subset_fits", 0.0), 2
@@ -2489,9 +2521,22 @@ def main():
     # emitting RAGGED_r16.jsonl). Reporter-first fallible like every
     # probe cell.
     if os.environ.get("BENCH_RAGGED", "0") == "1":
+        # BENCH_MESH=1 alongside BENCH_RAGGED=1 routes the same
+        # clustered fit through an explicit mesh: the ragged-mesh
+        # planner (ISSUE 17) bin-packs the bucket groups onto prefix
+        # sub-meshes and the record stamps the topology, the executed
+        # plan, and the mesh-induced pad_waste_frac
+        ragged_devices = None
+        if os.environ.get("BENCH_MESH", "0") == "1":
+            ragged_devices = (
+                int(os.environ["BENCH_MESH_DEVICES"])
+                if os.environ.get("BENCH_MESH_DEVICES")
+                else jax.local_device_count()
+            )
         try:
             reporter.add_rung(run_rung_ragged(
                 "ragged_coherent", solver_env=env,
+                n_devices=ragged_devices,
             ))
         except Exception as e:
             reporter.ladder.append(

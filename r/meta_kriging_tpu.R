@@ -177,10 +177,18 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
   # (the quantile-grid combine all-gathers ON the mesh, prediction
   # runs row-sharded), and with compile.store.dir set the compiled
   # programs are stored per mesh topology so a warm deployment pays
-  # zero compile. n.core must be divisible by n.devices. NULL
-  # (default) keeps the single-device path bit-identically; on a
-  # 1-device mesh results are also bit-identical to NULL (see the
-  # README's "Scale-out" section).
+  # zero compile. n.devices composes with every partition.method:
+  # equal-m partitions need n.core divisible by n.devices, while
+  # "coherent" (ragged) partitions need no divisibility at all — the
+  # ragged-mesh planner (ISSUE 17) bin-packs the occupied bucket
+  # groups onto the mesh (K-pad clones on prefix sub-meshes, small
+  # groups fused into super-batches) and reports the mesh-induced
+  # row overhead as $pad.waste.frac, guaranteed below
+  # min(1, max(0.25, 2/n.devices)). NULL (default) keeps the
+  # single-device path bit-identically; on a 1-device mesh results
+  # are also bit-identical to NULL — including the coherent path,
+  # whose 1-device plan degenerates to the host ragged fit (see the
+  # README's "Ragged partitions on the mesh" subsection).
   # run.log.dir: directory for the structured per-fit run log
   # (ISSUE 10, smk_tpu/obs/). When set, every fit appends one JSONL
   # timeline file there — phases as nested spans, every chunk/fault/
@@ -322,6 +330,11 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
     domains.dropped = as.integer(unlist(res$domains_dropped)),
     # path of the structured run log (NULL unless run.log.dir was set)
     run.log.path = res$run_log_path,
+    # mesh-induced pad-row overhead of a ragged (coherent) fit:
+    # 0 on the host ragged path, the ragged-mesh planner's
+    # pad_waste_frac under n.devices (< min(1, max(0.25,
+    # 2/n.devices))), NULL for equal-m partitions (ISSUE 17)
+    pad.waste.frac = res$pad_waste_frac,
     param.names = unlist(smk$api$param_names(as.integer(q), as.integer(p)))
   )
 }

@@ -31,9 +31,30 @@ Records:
 The exit gate is the conjunction of EVERY boolean leaf in every
 record — a regressed leg cannot ship a green RAGGED file.
 
+``--mesh`` (ISSUE 17) runs the ragged-MESH protocol instead ->
+RAGGED_MESH_r18.jsonl, every child on a FORCED 8-virtual-device CPU
+mesh (XLA_FLAGS=--xla_force_host_platform_device_count=8):
+
+1. mesh_cold — EMPTY store, fresh process: a Morton coherent
+   partition whose group subset counts do NOT divide the mesh is
+   bin-packed by compile/buckets.plan_ragged_mesh (K-pad clones on
+   prefix sub-meshes / super-batch fusion) and fits end-to-end;
+   exactly one chunk-program set per PLAN ENTRY (not per bucket x
+   full mesh), every program fresh, the executed plan stamped, and
+   the mesh-induced pad_waste_frac inside the planner's documented
+   waste_bound.
+2. mesh_warm — same store, NEW process, same forced topology: the
+   identical meshed ragged fit under recompile_guard(0) — zero
+   backend compiles, all-l2, draws bit-identical to cold.
+3. mesh_onedev — the SAME ragged problem on a 1-device mesh vs the
+   host (mesh=None) ragged path: the plan degenerates to the
+   identity and every SubsetResult field is BIT-IDENTICAL,
+   field-by-field (the bitwise contract; N-device runs are
+   tolerance-parity only — GSPMD reduction order differs).
+
 Usage: JAX_PLATFORMS=cpu python scripts/ragged_probe.py [out.jsonl]
-Runs on CPU in ~3-5 min (three program sets in the cold leg + three
-small legs).
+       JAX_PLATFORMS=cpu python scripts/ragged_probe.py --mesh [out.jsonl]
+Runs on CPU in ~3-5 min per protocol (cold program builds dominate).
 """
 
 import hashlib
@@ -59,6 +80,43 @@ RUNG_K, RUNG_M = 4, 32
 
 # parity leg: two 20-row subsets — default ladder pads to 23
 PAR_K, PAR_M, PAR_SAMPLES = 2, 20, 400
+
+# ragged-MESH rung (ISSUE 17): K=14 Morton-coherent subsets over
+# clustered blobs on a forced 8-device mesh. This exact shape makes
+# the planner exercise BOTH layout mechanisms: the coherent split
+# yields buckets (23, 32, 45) with group subset counts (1, 4, 9) —
+# the two small groups FUSE into one 5-device super-batch (m re-pad
+# 23 -> 32), and the k=9 group K-PADS to 10 on a 5-device prefix
+# sub-mesh — in only two plan entries (two chunk-program sets)
+MESH_D = 8
+MESH_N, MESH_K = 470, 14
+MESH_SAMPLES, MESH_CHUNK = 160, 40
+
+
+def _mesh_problem():
+    """Clustered coords (deterministic) so the Morton coherent split
+    is genuinely ragged — same recipe as bench.run_rung_ragged."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(18)
+    centers = [(0.2, 0.25), (0.55, 0.75), (0.8, 0.3)]
+    c0, c1 = MESH_N // 2, int(MESH_N * 0.3)
+    counts = [c0, c1, MESH_N + T - c0 - c1]
+    blobs = np.concatenate([
+        rng.normal(c, 0.07, size=(cnt, 2))
+        for c, cnt in zip(centers, counts)
+    ])
+    rng.shuffle(blobs)
+    coords = jnp.asarray(np.clip(blobs, 0.0, 1.0), jnp.float32)
+    x = jnp.asarray(
+        rng.normal(size=(MESH_N + T, Q, P)), jnp.float32
+    )
+    y = jnp.asarray(
+        rng.integers(0, 2, (MESH_N + T, Q)), jnp.float32
+    )
+    return (y[:MESH_N], x[:MESH_N], coords[:MESH_N],
+            coords[MESH_N:], x[MESH_N:])
 
 
 def _problem(n, t, seed=0):
@@ -284,11 +342,137 @@ def _child(mode: str, store_dir: str) -> None:
             ),
         )
 
+    elif mode in ("mesh_cold", "mesh_warm"):
+        from smk_tpu.compile.buckets import plan_ragged_mesh
+        from smk_tpu.parallel.executor import make_mesh
+        from smk_tpu.parallel.partition import coherent_partition
+
+        assert jax.device_count() == MESH_D, jax.device_count()
+        y, x, coords, ct, xt = _mesh_problem()
+        pp = coherent_partition(
+            jax.random.key(0), y, x, coords, MESH_K
+        )
+        ks = [len(g.subset_ids) for g in pp.groups]
+        plan = plan_ragged_mesh(list(pp.buckets), ks, MESH_D)
+        mesh = make_mesh(MESH_D)
+        cfg = SMKConfig(
+            n_subsets=MESH_K, n_samples=MESH_SAMPLES,
+            burn_in_frac=0.75, n_quantiles=50,
+            compile_store_dir=store_dir,
+        )
+        model = SpatialGPSampler(cfg, weight=1)
+        ps = ChunkPipelineStats()
+        t0 = time.perf_counter()
+        res = fit_subsets_chunked(
+            model, pp, ct, xt, jax.random.key(3), None,
+            chunk_iters=MESH_CHUNK, mesh=mesh, pipeline_stats=ps,
+        )
+        device_sync((res.param_grid, res.w_grid))
+        out["wall_s"] = round(time.perf_counter() - t0, 3)
+        if mode == "mesh_warm":
+            # the zero-compile pin on a SECOND fit in the now
+            # eager-warm process (same precedent as the host warm
+            # leg): the meshed ragged hot loop resolves every
+            # (bucket, sub-mesh) program without one backend compile
+            model2 = SpatialGPSampler(cfg, weight=1)
+            ps2 = ChunkPipelineStats()
+            with recompile_guard(0, "ragged mesh warm-store fit") as g:
+                res2 = fit_subsets_chunked(
+                    model2, pp, ct, xt, jax.random.key(3), None,
+                    chunk_iters=MESH_CHUNK, mesh=mesh,
+                    pipeline_stats=ps2,
+                )
+                device_sync((res2.param_grid, res2.w_grid))
+                out["compiles_observed"] = g.compiles
+            out["guarded_sources"] = ps2.program_summary()[
+                "program_sources"
+            ]
+            out["guarded_sha"] = _res_sha(res2)
+        chunk_keys = [
+            rec["key"] for rec in ps.programs
+            if rec["key"][0] in ("burn", "samp")
+        ]
+        out.update(
+            sizes=list(pp.sizes),
+            occupied_buckets=list(pp.buckets),
+            group_ks=ks,
+            plan=plan.summary(),
+            executed_plan=ps.ragged_mesh_plan,
+            pad_waste_frac=plan.pad_waste_frac,
+            waste_bound=round(plan.waste_bound, 6),
+            chunk_shape_pairs=sorted(
+                {(int(k[2]), int(k[4])) for k in chunk_keys}
+            ),
+            draws_sha256=_res_sha(res),
+            finite=bool(np.isfinite(np.asarray(res.param_grid)).all()),
+            store_files=len([
+                f for f in os.listdir(store_dir)
+                if f.endswith(".smkprog")
+            ]),
+            **ps.program_summary(),
+        )
+
+    elif mode == "mesh_onedev":
+        from smk_tpu.compile.buckets import plan_ragged_mesh
+        from smk_tpu.parallel.executor import make_mesh
+        from smk_tpu.parallel.partition import coherent_partition
+
+        y, x, coords, ct, xt = _mesh_problem()
+        pp = coherent_partition(
+            jax.random.key(0), y, x, coords, MESH_K
+        )
+        ks = [len(g.subset_ids) for g in pp.groups]
+        plan1 = plan_ragged_mesh(list(pp.buckets), ks, 1)
+        cfg = SMKConfig(
+            n_subsets=MESH_K, n_samples=MESH_SAMPLES,
+            burn_in_frac=0.75, n_quantiles=50,
+            compile_store_dir=store_dir,
+        )
+
+        def fit(mesh):
+            model = SpatialGPSampler(cfg, weight=1)
+            return fit_subsets_chunked(
+                model, pp, ct, xt, jax.random.key(3), None,
+                chunk_iters=MESH_CHUNK, mesh=mesh,
+            )
+
+        res_mesh = fit(make_mesh(1))
+        res_host = fit(None)
+        fields = {
+            f: bool(jnp.array_equal(a, b))
+            for f, a, b in zip(
+                type(res_host)._fields, res_mesh, res_host
+            )
+        }
+        out.update(
+            group_ks=ks,
+            plan_is_identity=bool(
+                len(plan1.entries) == len(pp.groups)
+                and all(
+                    e.padded_k == e.k_real and not e.fused
+                    for e in plan1.entries
+                )
+            ),
+            plan_pad_waste_frac=plan1.pad_waste_frac,
+            field_bitwise=fields,
+            bit_identical_all_fields=all(fields.values()),
+            mesh_sha=_res_sha(res_mesh),
+            host_sha=_res_sha(res_host),
+        )
+
     print("RAGGED_CHILD " + json.dumps(out), flush=True)
 
 
 def _run_child(mode: str, store_dir: str) -> dict:
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    if mode.startswith("mesh_"):
+        # every mesh child runs on the SAME forced 8-virtual-device
+        # CPU topology (the store's topology fingerprint must match
+        # between the cold and warm processes)
+        flags = env.get("XLA_FLAGS", "")
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={MESH_D}"
+        ).strip()
     proc = subprocess.run(
         [sys.executable, os.path.abspath(__file__),
          "--child", mode, store_dir],
@@ -431,9 +615,138 @@ def main(out_path: str) -> int:
     return 0 if ok else 1
 
 
+def main_mesh(out_path: str) -> int:
+    """The ISSUE 17 ragged-MESH protocol -> RAGGED_MESH_r18.jsonl."""
+    records = []
+    with tempfile.TemporaryDirectory() as store:
+        cold = _run_child("mesh_cold", store)
+        plan = cold["plan"]
+        n_entries = plan["n_entries"]
+        records.append({
+            "record": "mesh_cold_ragged",
+            "rung": {"n": MESH_N, "K": MESH_K, "sizes": cold["sizes"],
+                     "iters": MESH_SAMPLES, "chunk_iters": MESH_CHUNK,
+                     "n_devices": MESH_D},
+            "occupied_buckets": cold["occupied_buckets"],
+            "group_ks": cold["group_ks"],
+            # the raggedness premise: not every bucket group's subset
+            # count divides the mesh — the planner HAD to pad or fuse
+            "ks_not_all_divisible": any(
+                k % MESH_D for k in cold["group_ks"]
+            ),
+            # both layout mechanisms live in this one rung: a
+            # K-padded prefix-sub-mesh entry AND a fused super-batch
+            "exercises_k_pad": any(
+                e["padded_k"] > e["k_real"] for e in plan["entries"]
+            ),
+            "exercises_fusion": any(
+                e["fused"] for e in plan["entries"]
+            ),
+            # data (not gate) leaves: ints only, so the DESCRIPTIVE
+            # per-entry `fused` flag can't trip the boolean exit gate
+            "plan": {
+                **plan,
+                "entries": [
+                    {**e, "fused": int(e["fused"])}
+                    for e in plan["entries"]
+                ],
+            },
+            "executed_plan_matches": cold["executed_plan"] == plan,
+            "chunk_shape_pairs": cold["chunk_shape_pairs"],
+            # THE scale-out accounting claim: one chunk-program set
+            # per PLAN ENTRY (its (padded_k, bucket) shape on its
+            # prefix sub-mesh), not per bucket x full mesh
+            "one_program_set_per_plan_entry": len(
+                cold["chunk_shape_pairs"]
+            ) == n_entries,
+            "all_programs_built_fresh": set(
+                cold["program_sources"]
+            ) == {"fresh"},
+            "store_files": cold["store_files"],
+            "store_populated": cold["store_files"] > 0,
+            "pad_waste_frac": cold["pad_waste_frac"],
+            "waste_bound": cold["waste_bound"],
+            # the planner's documented guarantee, enforced on the
+            # executed plan
+            "pad_waste_within_bound": cold["pad_waste_frac"]
+            < cold["waste_bound"],
+            "wall_s_incl_compile": cold["wall_s"],
+            "compile_s": cold["compile_s"],
+            "draws_sha256": cold["draws_sha256"],
+            "run_finite": cold["finite"],
+        })
+
+        warm = _run_child("mesh_warm", store)
+        records.append({
+            "record": "mesh_warm_fresh_process",
+            "wall_s": warm["wall_s"],
+            "program_sources_run1": warm["program_sources"],
+            "all_programs_from_store": set(
+                warm["program_sources"]
+            ) == {"l2"},
+            "bit_identical_to_cold": warm["draws_sha256"]
+            == cold["draws_sha256"]
+            and warm["guarded_sha"] == cold["draws_sha256"],
+            "compiles_observed": warm["compiles_observed"],
+            "zero_compiles_on_warm_store": warm["compiles_observed"]
+            == 0,
+            "guarded_sources": warm["guarded_sources"],
+            "guarded_sources_cached": set(
+                warm["guarded_sources"]
+            ) <= {"l1", "l2"},
+            "run_finite": warm["finite"],
+        })
+
+        onedev = _run_child("mesh_onedev", store)
+        records.append({
+            "record": "mesh_onedev_bitwise_vs_host",
+            "group_ks": onedev["group_ks"],
+            "plan_is_identity": onedev["plan_is_identity"],
+            "plan_pad_waste_zero": onedev["plan_pad_waste_frac"]
+            == 0.0,
+            # field-by-field over every SubsetResult leaf — the
+            # bitwise half of the contract (N-device runs are
+            # tolerance-parity only: GSPMD reduction order differs)
+            "field_bitwise": onedev["field_bitwise"],
+            "bit_identical_all_fields": onedev[
+                "bit_identical_all_fields"
+            ],
+            "mesh_sha": onedev["mesh_sha"],
+            "host_sha": onedev["host_sha"],
+        })
+
+    ok = all(_bool_leaves(records))
+    records.append({
+        "record": "verdict",
+        "ok": ok,
+        "claims": [
+            "Morton coherent partition with group Ks not dividing "
+            f"the {MESH_D}-device mesh fits end-to-end: one chunk "
+            "program set per ragged-mesh PLAN ENTRY",
+            "fresh process on the warm store: 0 backend compiles, "
+            "all-l2, draws bit-identical to cold",
+            "mesh-induced pad_waste_frac stamped and inside the "
+            "planner's documented waste_bound",
+            "1-device-mesh plan is the identity and its fit is "
+            "bit-identical to the host ragged path, field-by-field",
+        ],
+    })
+    from smk_tpu.obs.reporter import write_records
+
+    write_records(out_path, records)
+    for r in records:
+        print(json.dumps(r))
+    return 0 if ok else 1
+
+
 if __name__ == "__main__":
     if len(sys.argv) >= 2 and sys.argv[1] == "--child":
         _child(sys.argv[2], sys.argv[3])
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--mesh":
+        sys.exit(main_mesh(
+            sys.argv[2] if len(sys.argv) > 2
+            else os.path.join(REPO, "RAGGED_MESH_r18.jsonl")
+        ))
     else:
         sys.exit(main(
             sys.argv[1] if len(sys.argv) > 1

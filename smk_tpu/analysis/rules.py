@@ -1,4 +1,4 @@
-"""smklint rules SMK101–SMK116 — the repo's JAX invariants, each one
+"""smklint rules SMK101–SMK117 — the repo's JAX invariants, each one
 traceable to the PR that established it (see analysis/RULES.md).
 
 All rules are pure-AST (no jax import). Shared machinery:
@@ -1957,6 +1957,128 @@ class BoundedCoalesceWaitRule(Rule):
                 )
 
 
+# ---------------------------------------------------------------------------
+# SMK117 — device-layout discipline (one K-divisibility arithmetic)
+# ---------------------------------------------------------------------------
+
+# The two sanctioned owners of K-axis device-layout arithmetic
+# (ISSUE 17): the ragged-mesh planner (pad-to-device-multiple,
+# super-batch fusion) in compile/buckets.py, and the executor's
+# layout oracle + contiguous-assignment helpers
+# (require_divisible_layout / fits_layout / subset_device_assignment
+# / sub_mesh) in parallel/executor.py.
+_LAYOUT_ZONES = (
+    "smk_tpu/compile/buckets",
+    "smk_tpu/parallel/executor",
+)
+
+# local names that denote a device count when used as a divisor
+_DEVICE_COUNT_NAMES = {
+    "n_devices",
+    "n_dev",
+    "num_devices",
+    "device_count",
+    "local_device_count",
+    "mesh_size",
+}
+
+
+class DeviceLayoutRule(Rule):
+    id = "SMK117"
+    name = "device-layout-discipline"
+    doc = (
+        "device-divisibility / K-padding arithmetic in smk_tpu/ "
+        "library code outside compile/buckets.py and "
+        "parallel/executor.py — `% <device count>`, "
+        "`// <device count>` (including ceil-to-multiple spellings "
+        "like `(k + n - 1) // n` and `-(-k // n)`), and "
+        "`ceil(k / <device count>)`, where the divisor is a device "
+        "count (`n_devices`-style names, `mesh.devices.size`, "
+        "`jax.device_count()`). A third copy of the layout check is "
+        "how a ragged fit silently desynchronizes from the "
+        "bin-packed RaggedMeshPlan the executor/checkpoint/"
+        "failure-domain oracles all derive from: route the check "
+        "through executor.require_divisible_layout / fits_layout, "
+        "and the padding through compile/buckets.plan_ragged_mesh "
+        "(ISSUE 17)"
+    )
+
+    def applies(self, module):
+        norm = module.norm_path()
+        if any(z in norm for z in _LAYOUT_ZONES):
+            return False
+        return "smk_tpu/" in norm
+
+    @staticmethod
+    def _ceil_aliases(tree) -> Set[str]:
+        """Local names ``math.ceil`` may be reached through bare:
+        ``from math import ceil [as c]`` — same from-import coverage
+        as SMK115's sqrt handling."""
+        out = {"ceil"}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.level == 0:
+                for a in node.names:
+                    if a.name == "ceil":
+                        out.add(a.asname or a.name)
+        return out
+
+    @classmethod
+    def _is_device_count(cls, node) -> bool:
+        """Is this expression a device count? Bare names from the
+        conventional set, attribute chains ending in
+        ``.devices.size``, ``jax.device_count()`` /
+        ``jax.local_device_count()`` calls — each optionally wrapped
+        in ``int(...)`` / ``len(...)``."""
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            if chain and chain[-1] in (
+                "device_count", "local_device_count"
+            ):
+                return True
+            if chain in (("int",), ("len",)) and len(node.args) == 1:
+                return cls._is_device_count(node.args[0])
+            return False
+        chain = attr_chain(node)
+        if not chain:
+            return False
+        if chain[-1] in _DEVICE_COUNT_NAMES:
+            return True
+        return len(chain) >= 2 and chain[-2:] == ("devices", "size")
+
+    def check(self, module, ctx):
+        ceil_aliases = self._ceil_aliases(module.tree)
+        msg = (
+            "K-axis device-layout arithmetic in library code — the "
+            "divisibility check belongs to the executor layout "
+            "oracle (parallel/executor.require_divisible_layout / "
+            "fits_layout) and the padding to the ragged-mesh "
+            "planner (compile/buckets.plan_ragged_mesh), the one "
+            "layout every sharding/checkpoint/failure-domain oracle "
+            "derives from (SMK117 device-layout-discipline)"
+        )
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.BinOp) and isinstance(
+                node.op, (ast.Mod, ast.FloorDiv)
+            ):
+                if self._is_device_count(node.right):
+                    yield self.finding(module, node, msg)
+            elif isinstance(node, ast.Call):
+                chain = attr_chain(node.func)
+                is_ceil = (
+                    len(chain) == 2
+                    and chain[0] == "math"
+                    and chain[1] == "ceil"
+                ) or (len(chain) == 1 and chain[0] in ceil_aliases)
+                if (
+                    is_ceil
+                    and len(node.args) == 1
+                    and isinstance(node.args[0], ast.BinOp)
+                    and isinstance(node.args[0].op, ast.Div)
+                    and self._is_device_count(node.args[0].right)
+                ):
+                    yield self.finding(module, node, msg)
+
+
 ALL_RULES = [
     BatchingRuleRule(),
     HostNondeterminismRule(),
@@ -1974,4 +2096,5 @@ ALL_RULES = [
     DeadlineDisciplineRule(),
     LadderDisciplineRule(),
     BoundedCoalesceWaitRule(),
+    DeviceLayoutRule(),
 ]

@@ -35,6 +35,7 @@ from smk_tpu.parallel.combine import combine_quantile_grids
 from smk_tpu.parallel.executor import (
     fit_subsets_sharded,
     fit_subsets_vmap,
+    fits_layout,
     make_mesh,
 )
 from smk_tpu.parallel.partition import (
@@ -85,6 +86,13 @@ class MetaKrigingResult(NamedTuple):
         survived: every index here lost ALL its subsets, the
         host-level fault signature. Empty on fault-free runs and
         always empty under ``"abort"``.
+    pad_waste_frac : mesh-induced pad-row waste of a ragged mesh fit
+        (ISSUE 17): the executed RaggedMeshPlan's fraction of padded
+        rows that exist only to satisfy the device layout, relative
+        to the host ragged path (compile/buckets.py; bounded by the
+        planner's documented ``waste_bound``). 0.0 for a ragged fit
+        off-mesh or on 1 device (the plan is the identity); None for
+        equal-m fits (no plan exists).
     """
 
     param_grid: jnp.ndarray
@@ -106,6 +114,7 @@ class MetaKrigingResult(NamedTuple):
     subsets_dropped: tuple = ()
     run_log_path: Optional[str] = None
     domains_dropped: tuple = ()
+    pad_waste_frac: Optional[float] = None
 
 
 def param_names(q: int, p: int) -> list[str]:
@@ -735,6 +744,20 @@ def _fit_meta_kriging_impl(
     run_mesh = mesh
     if sharded and run_mesh is None:
         run_mesh = make_mesh(axis=cfg.mesh_axis)
+    # ragged mesh fits execute under the bin-packed device layout
+    # (ISSUE 17) — derive the plan once here so failure-domain
+    # attribution and the pad_waste_frac headline both describe the
+    # layout the chunked executor actually runs (it re-derives the
+    # identical plan: pure deterministic integer math)
+    ragged_plan = None
+    if run_mesh is not None and isinstance(part, PaddedPartition):
+        from smk_tpu.compile.buckets import plan_ragged_mesh
+
+        ragged_plan = plan_ragged_mesh(
+            [g.bucket for g in part.groups],
+            [len(g.subset_ids) for g in part.groups],
+            int(run_mesh.devices.size),
+        )
     with phase_timer(times, "subset_fits", log=run_log):
         if (
             checkpoint_path is not None
@@ -807,7 +830,15 @@ def _fit_meta_kriging_impl(
         # enforced at host granularity (DomainSurvivalError when most
         # of the machines are gone) and the dropped DOMAINS — those
         # that lost every subset — are named in the result
-        dmap = FailureDomainMap.derive(cfg.n_subsets, run_mesh)
+        if ragged_plan is not None:
+            # the plan's per-entry sub-mesh layout is what ran — a
+            # global K-over-mesh derivation would attribute subsets
+            # by a placement the ragged fit never used
+            dmap = FailureDomainMap.derive_ragged(
+                ragged_plan, part, run_mesh
+            )
+        else:
+            dmap = FailureDomainMap.derive(cfg.n_subsets, run_mesh)
         domain_of_subset = np.asarray(dmap.domain_of_subset, int)
         domains_dropped = tuple(
             int(d) for d in range(dmap.n_domains)
@@ -857,9 +888,8 @@ def _fit_meta_kriging_impl(
         sample_par, sample_w = inverse_cdf_resample(
             k_resample, [dense_par, dense_w], cfg.resample_size
         )
-        if (
-            run_mesh is not None
-            and cfg.resample_size % run_mesh.devices.size == 0
+        if run_mesh is not None and fits_layout(
+            cfg.resample_size, int(run_mesh.devices.size)
         ):
             # sharded prediction composition (ISSUE 12): the S
             # resampled draws are embarrassingly parallel — lay them
@@ -929,4 +959,11 @@ def _fit_meta_kriging_impl(
         subsets_dropped=subsets_dropped,
         run_log_path=run_log.path if run_log is not None else None,
         domains_dropped=domains_dropped,
+        pad_waste_frac=(
+            ragged_plan.pad_waste_frac
+            if ragged_plan is not None
+            else (
+                0.0 if isinstance(part, PaddedPartition) else None
+            )
+        ),
     )

@@ -34,12 +34,24 @@ smklint **SMK115** (ladder-discipline) enforces the ownership: the
 constants) appearing in smk_tpu/ library code outside this module is
 a finding — a second ladder implementation that drifts by one
 rounding rule would silently fragment the compile store.
+
+Ragged MESH layout (ISSUE 17): :func:`plan_ragged_mesh` is the
+bin-packing planner that maps a ragged partition's occupied bucket
+groups onto a 1-D device mesh — padding each group's subset count K
+up to a device multiple when the waste is small, fusing
+sub-device-count groups into one super-batch entry otherwise — and
+emits an explicit :class:`RaggedMeshPlan` the chunked executor
+consumes. The K-axis device-divisibility arithmetic lives HERE and in
+the executor's layout oracle
+(``parallel/executor.require_divisible_layout``) only; smklint
+**SMK117** (device-layout-discipline) flags ``% n_devices`` /
+ceil-to-multiple spellings anywhere else.
 """
 
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Sequence, Tuple
 
 # The default smallest m-axis bucket: tiny subsets pad up to at least
 # this many rows. Dense-path subsets below ~8 rows are degenerate for
@@ -181,3 +193,270 @@ def pad_accounting(
         ),
         "occupied_buckets": sorted({int(b) for b in buckets}),
     }
+
+
+def ceil_to_multiple(n: int, multiple: int) -> int:
+    """Round ``n`` up to the nearest multiple of ``multiple``. The
+    one sanctioned ceil-to-multiple spelling (smklint SMK117): K-axis
+    device padding anywhere else in the library must route through
+    :func:`plan_ragged_mesh` or the executor layout oracle."""
+    if n < 0 or multiple < 1:
+        raise ValueError(
+            f"ceil_to_multiple needs n >= 0 and multiple >= 1, got "
+            f"n={n}, multiple={multiple}"
+        )
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+class RaggedMeshEntry(NamedTuple):
+    """One executable unit of a :class:`RaggedMeshPlan`: either a
+    single bucket group whose K was padded up to a device multiple,
+    or several sub-device-count groups fused into one super-batch.
+
+    ``group_ids`` are indices into the source ``PaddedPartition.
+    groups`` (ascending bucket order); ``buckets``/``ks`` are the
+    member groups' m-axis buckets and real subset counts, parallel to
+    ``group_ids``. The entry executes at m-bucket ``bucket`` (the max
+    member bucket — smaller-bucket members are re-padded on the m
+    axis) with ``padded_k`` subsets sharded over a ``n_devices``-long
+    prefix sub-mesh of the run mesh. Subsets ``[k_real:padded_k]``
+    are pad clones whose results the executor drops at stitch time
+    (``pad_mask``)."""
+
+    group_ids: Tuple[int, ...]
+    buckets: Tuple[int, ...]
+    ks: Tuple[int, ...]
+    bucket: int
+    k_real: int
+    padded_k: int
+    n_devices: int
+
+    @property
+    def per_device(self) -> int:
+        return self.padded_k // self.n_devices
+
+    @property
+    def pad_k(self) -> int:
+        return self.padded_k - self.k_real
+
+    @property
+    def fused(self) -> bool:
+        return len(self.group_ids) > 1
+
+    @property
+    def pad_mask(self) -> Tuple[bool, ...]:
+        """True for real subset slots, False for K-pad clones."""
+        return (True,) * self.k_real + (False,) * self.pad_k
+
+    @property
+    def real_rows(self) -> int:
+        """Host-path padded rows of the member groups (k·bucket per
+        member) — the denominator baseline for mesh-induced waste."""
+        return sum(k * b for k, b in zip(self.ks, self.buckets))
+
+    @property
+    def padded_rows(self) -> int:
+        return self.padded_k * self.bucket
+
+
+class RaggedMeshPlan(NamedTuple):
+    """Explicit device layout for a ragged (PaddedPartition) fit on a
+    mesh: one :class:`RaggedMeshEntry` per executable unit, in
+    ascending entry-bucket order. ``pad_waste_frac`` is the
+    mesh-INDUCED waste relative to the host ragged path (which this
+    plan degenerates to, entry-for-group and pad-free, on a 1-device
+    mesh): ``1 - sum(k_g * b_g) / sum(padded_k_e * bucket_e)``.
+    The planner guarantees ``pad_waste_frac < waste_bound``."""
+
+    entries: Tuple[RaggedMeshEntry, ...]
+    n_devices: int
+    fuse_max_rows_frac: float
+
+    @property
+    def pad_waste_frac(self) -> float:
+        real = sum(e.real_rows for e in self.entries)
+        padded = sum(e.padded_rows for e in self.entries)
+        return round(1.0 - real / padded, 6) if padded else 0.0
+
+    @property
+    def waste_bound(self) -> float:
+        """Documented planner guarantee: fused entries waste at most
+        ``fuse_max_rows_frac`` of their rows on m-axis re-padding (and
+        take zero K-pad, since fused K <= n_devices); K-padded entries
+        (single group, k >= n_devices) waste strictly less than
+        ``2 / n_devices`` (pad_k < per_device and n_sub > D·k/(k+D)
+        >= D/2). The two cases are disjoint, so the plan-level bound
+        is their max (capped at 1.0 — a waste FRACTION can never
+        reach it, which keeps the tiny-mesh bound non-vacuous)."""
+        return min(
+            1.0, max(self.fuse_max_rows_frac, 2.0 / self.n_devices)
+        )
+
+    def entry_of_group(self, group_id: int) -> int:
+        for i, e in enumerate(self.entries):
+            if group_id in e.group_ids:
+                return i
+        raise KeyError(f"group {group_id} not in plan")
+
+    def summary(self) -> Dict[str, object]:
+        return {
+            "n_entries": len(self.entries),
+            "n_devices": self.n_devices,
+            "pad_waste_frac": self.pad_waste_frac,
+            "waste_bound": round(self.waste_bound, 6),
+            "entries": [
+                {
+                    "group_ids": list(e.group_ids),
+                    "bucket": e.bucket,
+                    "k_real": e.k_real,
+                    "padded_k": e.padded_k,
+                    "n_devices": e.n_devices,
+                    "fused": e.fused,
+                }
+                for e in self.entries
+            ],
+        }
+
+
+def _k_layout(k: int, n_devices: int) -> Tuple[int, int]:
+    """(padded_k, n_sub) for a single group of ``k >= n_devices``
+    subsets: minimize per-device subset count first (``per_dev =
+    ceil(k / D)``), then shrink the sub-mesh to the fewest devices
+    that cover ``k`` at that per-device count — e.g. k=9 on D=8 runs
+    2-per-device on a 5-device sub-mesh (padded_k=10), not
+    1-per-device padded to 16."""
+    per_dev = -(-k // n_devices)
+    n_sub = -(-k // per_dev)
+    return per_dev * n_sub, n_sub
+
+
+def plan_ragged_mesh(
+    group_buckets: Sequence[int],
+    group_ks: Sequence[int],
+    n_devices: int,
+    *,
+    fuse_max_rows_frac: float = 0.25,
+) -> RaggedMeshPlan:
+    """Bin-pack a ragged partition's bucket groups onto a 1-D device
+    mesh of ``n_devices`` devices.
+
+    Inputs are the occupied groups in ascending bucket order
+    (``PaddedPartition.groups`` invariant): ``group_buckets[g]`` is
+    group g's m-axis bucket, ``group_ks[g]`` its real subset count.
+
+    Layout rules, in order:
+
+    - a group with ``k >= n_devices`` becomes its own entry, K padded
+      up to a device multiple by :func:`_k_layout` (K-pad waste
+      < 2/n_devices of its rows);
+    - groups with ``k < n_devices`` are greedily fused, in ascending
+      bucket order, into super-batch entries while the fused K stays
+      <= ``n_devices`` AND the m-axis re-pad waste (smaller-bucket
+      members re-padded to the fused entry's max bucket) stays <=
+      ``fuse_max_rows_frac`` of the fused rows; a fused entry runs
+      1-per-device on a ``k_real``-device sub-mesh with zero K-pad;
+    - on a 1-device mesh every rule degenerates to the identity: one
+      entry per group, no fusion, no pads — the plan IS the host
+      ragged path (the bit-identity contract in README/probe).
+
+    ``fuse_max_rows_frac`` is a planner parameter, not a config knob:
+    it does not enter the config digest or the compile-store keys
+    (program shapes are keyed by the resulting (bucket, padded_k,
+    sub-mesh) directly)."""
+    if len(group_buckets) != len(group_ks):
+        raise ValueError(
+            f"{len(group_buckets)} buckets vs {len(group_ks)} ks"
+        )
+    if not group_buckets:
+        raise ValueError("plan_ragged_mesh needs at least one group")
+    if n_devices < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n_devices}")
+    if not 0.0 <= fuse_max_rows_frac < 1.0:
+        raise ValueError(
+            "fuse_max_rows_frac must be in [0, 1), got "
+            f"{fuse_max_rows_frac}"
+        )
+    bs = [int(b) for b in group_buckets]
+    ks = [int(k) for k in group_ks]
+    if any(k < 1 for k in ks):
+        raise ValueError(f"group subset counts must be >= 1: {ks}")
+    if any(b2 <= b1 for b1, b2 in zip(bs, bs[1:])):
+        raise ValueError(
+            "group buckets must be strictly ascending (the "
+            f"PaddedPartition invariant): {bs}"
+        )
+
+    entries: List[RaggedMeshEntry] = []
+    # An open fusion batch of small (k < n_devices) groups, pending
+    # until a group breaks the K or row-waste budget.
+    open_ids: List[int] = []
+
+    def close_open() -> None:
+        if not open_ids:
+            return
+        mb = [bs[g] for g in open_ids]
+        mk = [ks[g] for g in open_ids]
+        k_real = sum(mk)
+        entries.append(
+            RaggedMeshEntry(
+                group_ids=tuple(open_ids),
+                buckets=tuple(mb),
+                ks=tuple(mk),
+                bucket=mb[-1],
+                k_real=k_real,
+                padded_k=k_real,
+                n_devices=k_real,
+            )
+        )
+        open_ids.clear()
+
+    for g, (b, k) in enumerate(zip(bs, ks)):
+        if k >= n_devices:
+            close_open()
+            padded_k, n_sub = _k_layout(k, n_devices)
+            entries.append(
+                RaggedMeshEntry(
+                    group_ids=(g,),
+                    buckets=(b,),
+                    ks=(k,),
+                    bucket=b,
+                    k_real=k,
+                    padded_k=padded_k,
+                    n_devices=n_sub,
+                )
+            )
+            continue
+        if open_ids:
+            cand = open_ids + [g]
+            ck = sum(ks[i] for i in cand)
+            # Ascending buckets: fusing re-pads every member's m axis
+            # up to THIS group's bucket.
+            real = sum(ks[i] * bs[i] for i in cand)
+            waste = 1.0 - real / (ck * b)
+            if ck > n_devices or waste > fuse_max_rows_frac:
+                close_open()
+        open_ids.append(g)
+    close_open()
+
+    # Entries hold unique buckets in ascending order (each source
+    # group has a distinct bucket and fusion keeps the max member),
+    # which keeps per-entry checkpoint paths (".b{bucket:05d}")
+    # collision-free.
+    ebs = [e.bucket for e in entries]
+    if any(b2 <= b1 for b1, b2 in zip(ebs, ebs[1:])):
+        raise AssertionError(f"plan entry buckets not ascending: {ebs}")
+
+    # Every entry must satisfy the executor's layout oracle by
+    # construction — the planner IS the fix the oracle's error names.
+    from smk_tpu.parallel.executor import require_divisible_layout
+
+    for e in entries:
+        require_divisible_layout(
+            e.padded_k, e.n_devices, what=f"plan entry bucket={e.bucket}"
+        )
+
+    return RaggedMeshPlan(
+        entries=tuple(entries),
+        n_devices=int(n_devices),
+        fuse_max_rows_frac=float(fuse_max_rows_frac),
+    )

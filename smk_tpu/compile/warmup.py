@@ -224,18 +224,81 @@ def precompile(
         # OCCUPIED bucket group — exactly the program sets the
         # ragged driver (parallel/recovery._fit_ragged_chunked)
         # resolves, so a store warmed here serves a ragged fit with
-        # zero backend compiles
+        # zero backend compiles. On a mesh (ISSUE 17) the driver
+        # executes the bin-packed RaggedMeshPlan instead, so the
+        # warm set is one program set per PLAN ENTRY — the entry's
+        # (padded K, entry bucket) shapes lowered against the
+        # entry's prefix sub-mesh.
         t0r = monotonic()
-        sub = [
-            precompile(
-                model, g.part, coords_test, x_test,
-                chunk_iters=chunk_iters, chunk_size=chunk_size,
-                store_dir=store_dir, stats=stats, mesh=mesh,
-                mesh_spec=mesh_spec, allow_topology=allow_topology,
+        rmesh = mesh
+        if rmesh is None and mesh_spec is not None:
+            shape_spec, kind_spec = mesh_spec
+            rmesh = mesh_from_spec(
+                tuple(shape_spec), kind_spec,
+                axis=model.config.mesh_axis,
+                allow_topology=allow_topology,
             )
-            for g in part.groups
-        ]
-        return {
+        plan = None
+        if rmesh is not None:
+            from smk_tpu.compile.buckets import plan_ragged_mesh
+            from smk_tpu.parallel.executor import fits_layout, sub_mesh
+            from smk_tpu.parallel.partition import Partition
+
+            plan = plan_ragged_mesh(
+                [g.bucket for g in part.groups],
+                [len(g.subset_ids) for g in part.groups],
+                int(rmesh.devices.size),
+            )
+            g0 = part.groups[0].part
+            q = g0.y.shape[-1]
+            p = g0.x.shape[-1]
+            d = g0.coords.shape[-1]
+            sub = []
+            for e in plan.entries:
+                ke, me = e.padded_k, e.bucket
+                epart = Partition(
+                    y=jax.ShapeDtypeStruct((ke, me, q), g0.y.dtype),
+                    x=jax.ShapeDtypeStruct(
+                        (ke, me, q, p), g0.x.dtype
+                    ),
+                    coords=jax.ShapeDtypeStruct(
+                        (ke, me, d), g0.coords.dtype
+                    ),
+                    mask=jax.ShapeDtypeStruct(
+                        (ke, me), g0.mask.dtype
+                    ),
+                    index=jax.ShapeDtypeStruct(
+                        (ke, me), g0.index.dtype
+                    ),
+                )
+                # mirror the driver's per-entry chunk_size rule: an
+                # entry keeps the lever only when it fits the
+                # entry's own layout (recovery._fit_ragged_chunked)
+                ecs = chunk_size
+                if chunk_size is not None and (
+                    ke % chunk_size != 0
+                    or not fits_layout(chunk_size, e.n_devices)
+                ):
+                    ecs = None
+                sub.append(
+                    precompile(
+                        model, epart, coords_test, x_test,
+                        chunk_iters=chunk_iters,
+                        chunk_size=ecs,
+                        store_dir=store_dir, stats=stats,
+                        mesh=sub_mesh(rmesh, e.n_devices),
+                    )
+                )
+        else:
+            sub = [
+                precompile(
+                    model, g.part, coords_test, x_test,
+                    chunk_iters=chunk_iters, chunk_size=chunk_size,
+                    store_dir=store_dir, stats=stats,
+                )
+                for g in part.groups
+            ]
+        report = {
             "store_dir": sub[0]["store_dir"],
             "n_programs": sum(r["n_programs"] for r in sub),
             "programs": [p for r in sub for p in r["programs"]],
@@ -246,6 +309,9 @@ def precompile(
                 for g in part.groups
             ],
         }
+        if plan is not None:
+            report["ragged_mesh_plan"] = plan.summary()
+        return report
 
     cfg = model.config
     t0 = monotonic()

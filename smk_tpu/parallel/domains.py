@@ -210,7 +210,14 @@ class FailureDomainMap:
         is the failure unit)."""
         from smk_tpu.parallel.executor import subset_device_assignment
 
-        devices = subset_device_assignment(k, mesh)
+        return cls._from_devices(
+            subset_device_assignment(k, mesh), granularity
+        )
+
+    @classmethod
+    def _from_devices(cls, devices, granularity) -> "FailureDomainMap":
+        """Device-per-subset list → domain map (the shared tail of
+        :meth:`from_mesh` / :meth:`from_ragged_plan`)."""
         if granularity == "device":
             ids = [int(getattr(d, "id", i)) for i, d in enumerate(devices)]
             order = sorted(set(ids))
@@ -231,6 +238,51 @@ class FailureDomainMap:
             domain_of_subset=tuple(remap[p] for p in procs),
             labels=tuple(f"process:{p}" for p in order),
         )
+
+    @classmethod
+    def from_ragged_plan(
+        cls, plan, part, mesh, granularity: str = "process"
+    ) -> "FailureDomainMap":
+        """Derive the GLOBAL-subset domain map of a ragged mesh fit
+        (ISSUE 17): each RaggedMeshPlan entry lays its padded K
+        contiguously over a prefix sub-mesh, so a global subset's
+        device is the entry sub-mesh device of its entry-local row —
+        the exact placement ``recovery._fit_ragged_chunked`` executes,
+        K-pad clone rows excluded (they carry no attributable chain).
+        A plain ``from_mesh(K_global, mesh)`` would attribute subsets
+        by a layout the ragged fit never runs — exactly the
+        desynchronization the map exists to prevent."""
+        from smk_tpu.parallel.executor import (
+            sub_mesh,
+            subset_device_assignment,
+        )
+
+        dev_of = {}
+        for e in plan.entries:
+            smesh = sub_mesh(mesh, e.n_devices)
+            devices = subset_device_assignment(e.padded_k, smesh)
+            ids = [
+                j
+                for g in e.group_ids
+                for j in part.groups[g].subset_ids
+            ]
+            for r, j in enumerate(ids):
+                dev_of[j] = devices[r]
+        return cls._from_devices(
+            [dev_of[j] for j in range(part.n_subsets)], granularity
+        )
+
+    @classmethod
+    def derive_ragged(cls, plan, part, mesh) -> "FailureDomainMap":
+        """:meth:`derive`'s granularity policy over a ragged mesh
+        plan: process-granular, falling back to device granularity
+        when one process owns the whole multi-chip mesh."""
+        m = cls.from_ragged_plan(plan, part, mesh, granularity="process")
+        if m.n_domains == 1 and int(mesh.devices.size) > 1:
+            return cls.from_ragged_plan(
+                plan, part, mesh, granularity="device"
+            )
+        return m
 
     @classmethod
     def from_shard_rows(cls, shard_rows) -> "FailureDomainMap":

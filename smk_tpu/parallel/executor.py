@@ -333,6 +333,45 @@ def count_subset_factorizations(
     return state.phi_accept, counts
 
 
+class SubsetLayoutError(ValueError):
+    """A subset count K that cannot be laid out contiguously over the
+    requested device count. Raised only by
+    :func:`require_divisible_layout` — the one owner of the
+    K-divisibility check (smklint SMK117)."""
+
+
+def require_divisible_layout(k: int, n_devices: int, *, what: str = "K") -> int:
+    """The layout oracle every sharded path consults: the contiguous
+    1-D leading-K layout needs ``k % n_devices == 0``. Returns the
+    per-device subset count; raises :class:`SubsetLayoutError`
+    otherwise, naming the ragged-mesh planner
+    (``compile/buckets.plan_ragged_mesh``) as the fix — ragged
+    partitions should never hand a raw group K to a sharded program,
+    they should fan out through a :class:`RaggedMeshPlan` whose
+    entries satisfy this oracle by construction."""
+    if n_devices < 1:
+        raise SubsetLayoutError(
+            f"n_devices must be >= 1, got {n_devices}"
+        )
+    if k % n_devices != 0:
+        raise SubsetLayoutError(
+            f"{what}={k} must be divisible by mesh size "
+            f"{n_devices}; for ragged bucket groups, route the fit "
+            "through the ragged-mesh planner "
+            "(smk_tpu.compile.buckets.plan_ragged_mesh), which pads "
+            "or fuses group Ks onto sub-meshes so every entry "
+            "satisfies this layout"
+        )
+    return k // n_devices
+
+
+def fits_layout(k: int, n_devices: int) -> bool:
+    """Non-raising form of :func:`require_divisible_layout` — the
+    predicate callers use to CHOOSE a sharded layout (e.g. the
+    resample grid in api.py) rather than demand one."""
+    return n_devices >= 1 and k % n_devices == 0
+
+
 def subset_device_assignment(k: int, mesh: Mesh) -> list:
     """Device of each of the ``k`` subsets under the contiguous
     1-D layout every sharded path here uses (``NamedSharding(P(axis))``
@@ -343,10 +382,7 @@ def subset_device_assignment(k: int, mesh: Mesh) -> list:
     it, so a layout change cannot silently desynchronize fault
     attribution from the actual placement."""
     devs = list(mesh.devices.flat)
-    n_dev = len(devs)
-    if k % n_dev != 0:
-        raise ValueError(f"K={k} must be divisible by mesh size {n_dev}")
-    per = k // n_dev
+    per = require_divisible_layout(k, len(devs))
     return [devs[i // per] for i in range(k)]
 
 
@@ -421,6 +457,29 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "subsets") -> Mesh:
     return Mesh(np.array(devs), (axis,))
 
 
+def sub_mesh(mesh: Mesh, n_devices: int) -> Mesh:
+    """A prefix sub-mesh: the first ``n_devices`` devices of a 1-D
+    parent mesh, same axis name. This is how a RaggedMeshPlan entry's
+    ``n_devices`` becomes an executable mesh — prefix slicing keeps
+    the contiguous layout oracle (:func:`subset_device_assignment`)
+    and the topology fingerprint (compile/programs.py) pure functions
+    of (parent mesh, entry device count). Returns the parent itself
+    when the sizes already match, so the plan's degenerate 1-device /
+    full-mesh entries reuse the parent mesh object (and its
+    fingerprint) exactly."""
+    devs = list(mesh.devices.flat)
+    if n_devices < 1 or n_devices > len(devs):
+        raise ValueError(
+            f"sub_mesh(n_devices={n_devices}) outside the parent "
+            f"mesh's 1..{len(devs)} device range"
+        )
+    if n_devices == len(devs):
+        return mesh
+    import numpy as np
+
+    return Mesh(np.array(devs[:n_devices]), (mesh.axis_names[0],))
+
+
 def fit_subsets_sharded(
     model: SpatialGPSampler,
     part: Partition,
@@ -444,9 +503,7 @@ def fit_subsets_sharded(
         mesh = make_mesh(axis=model.config.mesh_axis)
     axis = mesh.axis_names[0]
     k = part.n_subsets
-    n_dev = mesh.devices.size
-    if k % n_dev != 0:
-        raise ValueError(f"K={k} must be divisible by mesh size {n_dev}")
+    require_divisible_layout(k, mesh.devices.size)
 
     sharded = NamedSharding(mesh, P(axis))
     replicated = NamedSharding(mesh, P())

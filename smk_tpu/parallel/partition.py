@@ -287,6 +287,96 @@ def padded_partition(
     )
 
 
+def _extend_m_axis(part: Partition, m_new: int) -> Partition:
+    """Re-pad an equal-m :class:`Partition` stack up to ``m_new``
+    rows per subset — the m-axis half of super-batch fusion (a
+    RaggedMeshPlan entry runs every member group at the entry's max
+    bucket). Appended rows carry the shared pad-row identity (mask 0,
+    index -1, zeroed y/x) with far-line pseudo-coordinates computed
+    from the STACK's own coords: the stack already contains far-line
+    pads beyond the data's range, so a fresh line past the stack
+    maximum is distinct from every real point AND every existing pad
+    point. (Fused entries are tolerance-parity with the host path,
+    never bitwise — the 1-device plan never fuses, so the bitwise
+    contract is untouched.)"""
+    k, m = part.mask.shape
+    if m_new < m:
+        raise ValueError(f"cannot shrink m axis {m} -> {m_new}")
+    if m_new == m:
+        return part
+    extra = m_new - m
+    d = part.coords.shape[-1]
+    dtype = part.coords.dtype
+    span = jnp.max(part.coords) - jnp.min(part.coords) + 1.0
+    far = jnp.max(part.coords) + span
+    offsets = (
+        jnp.arange(extra, dtype=dtype)[None, :, None]
+        * jnp.ones((1, 1, d), dtype)
+        * span
+        * 0.01
+    )
+    pad_coords = jnp.broadcast_to(far + offsets, (k, extra, d))
+    q = part.y.shape[-1]
+    p = part.x.shape[-1]
+    return Partition(
+        y=jnp.concatenate(
+            [part.y, jnp.zeros((k, extra, q), part.y.dtype)], axis=1
+        ),
+        x=jnp.concatenate(
+            [part.x, jnp.zeros((k, extra, q, p), part.x.dtype)],
+            axis=1,
+        ),
+        coords=jnp.concatenate([part.coords, pad_coords], axis=1),
+        mask=jnp.concatenate(
+            [part.mask, jnp.zeros((k, extra), part.mask.dtype)],
+            axis=1,
+        ),
+        index=jnp.concatenate(
+            [
+                part.index,
+                jnp.full((k, extra), -1, part.index.dtype),
+            ],
+            axis=1,
+        ),
+    )
+
+
+def ragged_mesh_entry_partition(part: PaddedPartition, entry) -> tuple:
+    """The executable stack of one RaggedMeshPlan entry
+    (compile/buckets.py): member bucket groups re-padded on the m
+    axis to the entry bucket, concatenated along K in entry order,
+    then K-padded up to ``entry.padded_k`` with CLONES of the entry's
+    first real subset. Clones — not all-masked subsets — because a
+    subset with zero real rows has a degenerate likelihood the
+    sampler was never asked to survive; a clone just replays subset
+    0's well-posed chain, and the executor drops rows
+    ``[k_real:padded_k]`` at stitch time.
+
+    Returns ``(Partition, subset_ids)`` — the global original subset
+    index per REAL row. A single-group entry with no K-pad returns
+    the group's stack object unchanged (the 1-device-mesh plan is the
+    identity, so its per-entry fits are bit-identical to the host
+    ragged path by construction)."""
+    groups = [part.groups[g] for g in entry.group_ids]
+    ids = [j for g in groups for j in g.subset_ids]
+    if len(groups) == 1 and entry.pad_k == 0:
+        return groups[0].part, ids
+    stacks = [_extend_m_axis(g.part, entry.bucket) for g in groups]
+    stacked = jax.tree_util.tree_map(
+        lambda *leaves: jnp.concatenate(leaves, axis=0), *stacks
+    )
+    if entry.pad_k:
+        stacked = jax.tree_util.tree_map(
+            lambda a: jnp.concatenate(
+                [a]
+                + [a[0:1]] * entry.pad_k,
+                axis=0,
+            ),
+            stacked,
+        )
+    return stacked, ids
+
+
 def coherent_assignments(
     coords,
     n_subsets: int,

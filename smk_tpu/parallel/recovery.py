@@ -34,6 +34,7 @@ import numpy as np
 
 from smk_tpu.analysis.sanitizers import explicit_d2h
 from smk_tpu.compile import programs as compile_programs
+from smk_tpu.compile.buckets import plan_ragged_mesh
 from smk_tpu.parallel import checkpoint as dist_ckpt
 from smk_tpu.parallel.domains import ChunkWatchdog, FailureDomainMap
 from smk_tpu.models.probit_gp import (
@@ -45,6 +46,9 @@ from smk_tpu.models.probit_gp import (
 from smk_tpu.parallel.executor import (
     DATA_AXES,
     HostSnapshot,
+    fits_layout,
+    require_divisible_layout,
+    sub_mesh,
     tree_nbytes,
     write_draws,
     init_subset_states,
@@ -52,7 +56,11 @@ from smk_tpu.parallel.executor import (
     subset_chain_keys,
     subset_runner,
 )
-from smk_tpu.parallel.partition import PaddedPartition, Partition
+from smk_tpu.parallel.partition import (
+    PaddedPartition,
+    Partition,
+    ragged_mesh_entry_partition,
+)
 from smk_tpu.utils.checkpoint import (
     BackgroundWriter,
     is_key_leaf,
@@ -1110,6 +1118,25 @@ def _fit_ragged_chunked(
       spends on each group's recorded work chunks and the run
       truncates (returns None, checkpoints on disk) when it runs
       out.
+
+    **On a mesh** (ISSUE 17) the loop runs over an explicit
+    :class:`~smk_tpu.compile.buckets.RaggedMeshPlan` instead of raw
+    bucket groups: each entry is a group whose K was padded up to a
+    device multiple (pad subsets CLONE the entry's first real subset
+    and are sliced off before stitching), or several
+    sub-device-count groups fused into one super-batch — executed on
+    a prefix sub-mesh of the run mesh sized by the plan, so every
+    per-entry ``_fit_subsets_chunked_impl`` call satisfies the
+    executor's layout oracle by construction. Entry checkpoints keep
+    the ``<path>.bNNNNN`` naming (entry buckets are unique), the
+    global once-split key stream is untouched (pads reuse the first
+    real subset's keys and consume no key material), and a 1-device
+    mesh degenerates the plan to the identity — per-group, pad-free,
+    parent-mesh — so its fits are bit-identical to the host ragged
+    path. A caller ``chunk_size`` that does not satisfy an entry's
+    own layout (divides padded K, divides the sub-mesh) is dropped
+    for that entry rather than raising over a layout the planner
+    chose.
     """
     cfg = model.config
     if domain_map is not None:
@@ -1143,6 +1170,19 @@ def _fit_ragged_chunked(
     if run_log is not None and pstats is not None:
         pstats.run_log = run_log
 
+    # Ragged mesh layout (ISSUE 17): any mesh — including 1 device —
+    # routes through the bin-packing planner; the 1-device plan is
+    # the identity, so the host loop below IS its execution.
+    plan = None
+    if mesh is not None:
+        plan = plan_ragged_mesh(
+            [g.bucket for g in part.groups],
+            [len(g.subset_ids) for g in part.groups],
+            int(mesh.devices.size),
+        )
+        if pstats is not None:
+            pstats.ragged_mesh_plan = plan.summary()
+
     group_results = []
     ragged_groups = []
     remaining = stop_after_chunks
@@ -1153,25 +1193,51 @@ def _fit_ragged_chunked(
         )
         if run_log is not None else contextlib.nullcontext()
     )
+    units = list(plan.entries) if plan is not None else list(part.groups)
     try:
         with root_span:
-            for gi, g in enumerate(part.groups):
-                ids = list(g.subset_ids)
-                sub_keys = keys_all[jnp.asarray(ids)]
+            for gi, u in enumerate(units):
+                if plan is None:
+                    gbucket = u.bucket
+                    ids = list(u.subset_ids)
+                    upart = u.part
+                    umesh = mesh
+                    k_real, pad_k = len(ids), 0
+                else:
+                    gbucket = u.bucket
+                    upart, ids = ragged_mesh_entry_partition(part, u)
+                    umesh = sub_mesh(mesh, u.n_devices)
+                    k_real, pad_k = u.k_real, u.pad_k
+                # K-pad clone subsets replay the entry's FIRST real
+                # subset — data AND keys — so the once-split global
+                # key stream is untouched and no real subset's chain
+                # can depend on the plan's padding.
+                key_ids = ids + [ids[0]] * pad_k
+                sub_keys = keys_all[jnp.asarray(key_ids)]
+                ucs = chunk_size
+                if plan is not None and chunk_size is not None and (
+                    u.padded_k % chunk_size != 0
+                    or not fits_layout(chunk_size, u.n_devices)
+                ):
+                    # chunk_size is an equal-m memory lever; an entry
+                    # keeps it only when it fits the entry's OWN
+                    # layout, else the entry runs unchunked instead
+                    # of erroring over a layout the planner chose
+                    ucs = None
                 gpath = (
                     None if checkpoint_path is None
-                    else f"{checkpoint_path}.b{g.bucket:05d}"
+                    else f"{checkpoint_path}.b{gbucket:05d}"
                 )
                 gprog = None
                 if progress is not None:
-                    def gprog(info, _b=g.bucket, _ids=tuple(ids)):
+                    def gprog(info, _b=gbucket, _ids=tuple(ids)):
                         progress(
                             {**info, "bucket": _b,
                              "subset_ids": list(_ids)}
                         )
                 gspan = (
                     run_log.span(
-                        "bucket_group", bucket=g.bucket,
+                        "bucket_group", bucket=gbucket,
                         n_subsets=len(ids),
                     )
                     if run_log is not None
@@ -1194,11 +1260,11 @@ def _fit_ragged_chunked(
                 with gspan:
                     try:
                         res = _fit_subsets_chunked_impl(
-                            model, g.part, coords_test, x_test,
+                            model, upart, coords_test, x_test,
                             key, beta_init,
                             chunk_iters=chunk_iters,
-                            checkpoint_path=gpath, mesh=mesh,
-                            chunk_size=chunk_size, progress=gprog,
+                            checkpoint_path=gpath, mesh=umesh,
+                            chunk_size=ucs, progress=gprog,
                             stop_after_chunks=remaining,
                             nan_guard=nan_guard,
                             pipeline_stats=pstats, run_log=run_log,
@@ -1207,30 +1273,72 @@ def _fit_ragged_chunked(
                     except SubsetNaNError as e:
                         # group-local rows -> original subset ids:
                         # the abort contract names shards the CALLER
-                        # can rerun_subsets
+                        # can rerun_subsets. A K-pad clone row maps
+                        # to its source (the first real subset) and
+                        # dedupes away.
+                        gl = [
+                            ids[j] if j < len(ids) else ids[0]
+                            for j in e.subset_ids
+                        ]
+                        if pad_k:
+                            seen = set()
+                            gl = [
+                                i for i in gl
+                                if not (i in seen or seen.add(i))
+                            ]
                         raise SubsetNaNError(
-                            [ids[j] for j in e.subset_ids],
-                            e.iteration,
+                            gl, e.iteration,
                         ) from e
                 if pstats is not None:
                     _remap_fault_events(
-                        pstats, faults_before, ids
+                        pstats, faults_before,
+                        ids + [-1] * pad_k,
                     )
-                    ragged_groups.append({
-                        "bucket": int(g.bucket),
-                        "n_subsets": len(ids),
+                    grec = {
+                        "bucket": int(gbucket),
+                        "n_subsets": k_real,
                         "live_ess_sum_final": _group_ess_final(
                             pstats, entries_before
                         ),
-                    })
+                    }
+                    if plan is not None:
+                        grec.update(
+                            group_ids=list(u.group_ids),
+                            padded_k=u.padded_k,
+                            n_devices=u.n_devices,
+                            fused=u.fused,
+                        )
+                    ragged_groups.append(grec)
                     pstats.ragged_groups = ragged_groups
                 if res is None:
                     return None
+                if pad_k:
+                    # drop the K-pad clone rows before stitching —
+                    # the plan's padding must be invisible to every
+                    # downstream consumer
+                    res = jax.tree_util.tree_map(
+                        lambda a, _k=k_real: a[:_k], res
+                    )
+                if plan is not None and int(mesh.devices.size) > 1:
+                    # entries ran on different prefix sub-meshes;
+                    # replicate each compressed result onto the full
+                    # run mesh so the cross-entry stitch (and the
+                    # combine's gather) sees one placement — the
+                    # same ICI replication gather_grids performs
+                    from jax.sharding import (
+                        NamedSharding,
+                        PartitionSpec as _P,
+                    )
+
+                    _repl = NamedSharding(mesh, _P())
+                    res = jax.tree_util.tree_map(
+                        lambda a: jax.device_put(a, _repl), res
+                    )
                 if remaining is not None and pstats is not None:
                     remaining -= (
                         _n_work_chunks(pstats) - chunks_before
                     )
-                    if remaining <= 0 and gi < len(part.groups) - 1:
+                    if remaining <= 0 and gi < len(units) - 1:
                         # budget exhausted exactly at a group
                         # boundary with groups left: the run is
                         # truncated (the stop_after_chunks contract
@@ -1260,14 +1368,19 @@ def _remap_fault_events(
     """Rewrite the fault events a group fit recorded (group-local
     subset rows) into ORIGINAL subset indices, so
     ``fault_summary()`` / bench records never name a ragged fit's
-    subsets by their position inside a bucket group."""
+    subsets by their position inside a bucket group. An ``ids`` entry
+    of -1 marks a K-pad clone row (ragged mesh plan): its faults are
+    dropped — the clone's result is discarded anyway, and its source
+    subset reports its own faults under its own row."""
     for ev in pstats.fault_events[start:]:
         for field in ("retried", "dropped", "deferred"):
             if field in ev:
-                ev[field] = [ids[j] for j in ev[field]]
+                mapped = [ids[j] for j in ev[field]]
+                ev[field] = [i for i in mapped if i >= 0]
         if "attempts" in ev:
             ev["attempts"] = {
                 ids[j]: n for j, n in ev["attempts"].items()
+                if ids[j] >= 0
             }
 
 
@@ -1419,17 +1532,13 @@ def _fit_subsets_chunked_impl(
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         axis = mesh.axis_names[0]
-        if k % mesh.devices.size != 0:
-            raise ValueError(
-                f"K={k} must be divisible by mesh size {mesh.devices.size}"
-            )
-        if chunk_size is not None and chunk_size % mesh.devices.size != 0:
+        require_divisible_layout(k, mesh.devices.size)
+        if chunk_size is not None:
             # each lax.map step runs `chunk_size` subsets over the
             # whole mesh — a chunk smaller than the mesh would leave
             # devices idle (or force GSPMD resharding) every step
-            raise ValueError(
-                f"chunk_size={chunk_size} must be divisible by mesh "
-                f"size {mesh.devices.size} when both are given"
+            require_divisible_layout(
+                chunk_size, mesh.devices.size, what="chunk_size"
             )
         shard = NamedSharding(mesh, P(axis))
         repl = NamedSharding(mesh, P())

@@ -166,6 +166,12 @@ class ChunkPipelineStats:
     # streaming ESS instead of seeing only the last group's
     # boundaries. None on equal-m runs.
     ragged_groups: Any = None
+    # ragged MESH layout (ISSUE 17, compile/buckets.plan_ragged_mesh):
+    # the RaggedMeshPlan.summary() dict the fit executed under —
+    # entries, per-entry sub-mesh sizes, and the plan-level
+    # pad_waste_frac bench/probe stamp top-level. None on host-path
+    # (mesh-less) and equal-m runs.
+    ragged_mesh_plan: Any = None
     _lock: threading.Lock = field(
         default_factory=threading.Lock, repr=False
     )
@@ -375,6 +381,10 @@ class ChunkPipelineStats:
             ),
             # per-bucket-group ledger on ragged fits (None otherwise)
             "ragged_groups": self.ragged_groups,
+            # ISSUE 17: the bin-packed device layout a ragged MESH
+            # fit executed under (None off-mesh) — carries the
+            # mesh-induced pad_waste_frac headline
+            "ragged_mesh_plan": self.ragged_mesh_plan,
             # ISSUE 7 fault-isolation accounting: policy, retry
             # ladder history, and the final dropped-subset set —
             # JSON-friendly (string subset ids) for bench/protocol

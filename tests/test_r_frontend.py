@@ -348,6 +348,29 @@ class TestScaleOutKnobs:
             smk.fit_meta_kriging
         ).parameters
 
+    def test_ragged_mesh_composition_wired(self):
+        """The ISSUE 17 front-end surface: ``n.devices`` composes
+        with the coherent (ragged) partition — the stale 'n.core
+        must be divisible by n.devices' doc rule is gone, the doc
+        names the ragged-mesh planner's contract, and the result
+        list carries ``$pad.waste.frac`` from the Python result's
+        ``pad_waste_frac`` field (which really exists)."""
+        import os
+
+        r_src = open(
+            os.path.join(
+                os.path.dirname(os.path.dirname(__file__)),
+                "r", "meta_kriging_tpu.R",
+            )
+        ).read()
+        assert "must be divisible by n.devices" not in r_src
+        assert "composes with every partition.method" in r_src
+        assert "pad.waste.frac = res$pad_waste_frac" in r_src
+        # and the Python field it reads really exists
+        from smk_tpu.api import MetaKrigingResult
+
+        assert "pad_waste_frac" in MetaKrigingResult._fields
+
 
 class TestResilienceKnobs:
     def test_watchdog_and_dist_init_args_wired(self):

@@ -1,0 +1,350 @@
+"""Ragged-mesh bin-packing planner (ISSUE 17): pure unit tests for
+``smk_tpu.compile.buckets.plan_ragged_mesh`` and its consumers'
+derived structures.
+
+Covers the planner contract layer by layer:
+
+- **K layout math**: pad-to-device-multiple rounding with sub-mesh
+  shrinking (k=9 on D=8 runs 2-per-device on 5 devices, not
+  1-per-device on 8), ``ceil_to_multiple`` validation.
+- **Fusion rules**: sub-device-count groups fuse while fused K <= D
+  AND m-axis re-pad waste <= ``fuse_max_rows_frac``; either budget
+  breach closes the batch.
+- **Plan invariants**: ascending unique entry buckets (checkpoint
+  path collision-freedom), ``entry_of_group`` totality, determinism,
+  1-device identity (the bitwise contract's foundation),
+  ``pad_waste_frac < waste_bound``.
+- **Layout oracle** (parallel/executor.py): typed
+  ``SubsetLayoutError`` naming the planner, ``fits_layout``
+  predicate, prefix ``sub_mesh`` slicing.
+- **Entry partition + failure domains**: pad-clone identity, pad
+  masks, and the plan-derived global subset -> domain map — tiny
+  host arrays only, no program builds.
+
+The mesh-executing legs (cold/warm compile accounting, 1-device
+bitwise parity field-by-field) live in scripts/ragged_probe.py
+--mesh -> RAGGED_MESH_r18.jsonl; nothing here traces a fit.
+"""
+
+# smklint: test-budget=pure integer planner math and tiny host-array partition stacks; no jax programs are built
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from smk_tpu.compile.buckets import (
+    ceil_to_multiple,
+    plan_ragged_mesh,
+)
+from smk_tpu.parallel.domains import FailureDomainMap
+from smk_tpu.parallel.executor import (
+    SubsetLayoutError,
+    fits_layout,
+    make_mesh,
+    require_divisible_layout,
+    sub_mesh,
+)
+from smk_tpu.parallel.partition import (
+    padded_partition,
+    ragged_mesh_entry_partition,
+)
+
+
+# ---------------------------------------------------------------------------
+# K layout math
+# ---------------------------------------------------------------------------
+
+
+class TestKLayout:
+    def test_ceil_to_multiple(self):
+        assert ceil_to_multiple(9, 8) == 16
+        assert ceil_to_multiple(16, 8) == 16
+        assert ceil_to_multiple(0, 8) == 0
+        assert ceil_to_multiple(5, 1) == 5
+        with pytest.raises(ValueError, match="multiple >= 1"):
+            ceil_to_multiple(5, 0)
+        with pytest.raises(ValueError, match="n >= 0"):
+            ceil_to_multiple(-1, 4)
+
+    def test_sub_mesh_shrink_beats_full_mesh_pad(self):
+        """k=9, D=8: per_dev = ceil(9/8) = 2, so a 5-device sub-mesh
+        covers it at padded_k=10 — NOT 1-per-device K-padded to 16
+        (which would waste 7/16 of the rows)."""
+        plan = plan_ragged_mesh([16], [9], 8)
+        (e,) = plan.entries
+        assert (e.padded_k, e.n_devices, e.per_device) == (10, 5, 2)
+        assert e.pad_k == 1 and not e.fused
+        assert e.pad_mask == (True,) * 9 + (False,)
+
+    def test_exact_multiple_no_pad(self):
+        plan = plan_ragged_mesh([16], [16], 8)
+        (e,) = plan.entries
+        assert (e.padded_k, e.n_devices, e.pad_k) == (16, 8, 0)
+        assert plan.pad_waste_frac == 0.0
+
+    @pytest.mark.parametrize("k,d", [(9, 8), (11, 8), (17, 8),
+                                     (5, 4), (13, 4), (3, 2)])
+    def test_kpad_waste_strictly_under_two_over_d(self, k, d):
+        plan = plan_ragged_mesh([16], [k], d)
+        (e,) = plan.entries
+        assert e.padded_k >= k
+        assert e.padded_k % e.n_devices == 0
+        waste = 1.0 - e.real_rows / e.padded_rows
+        assert waste < 2.0 / d
+        assert plan.pad_waste_frac < plan.waste_bound
+
+
+# ---------------------------------------------------------------------------
+# fusion rules
+# ---------------------------------------------------------------------------
+
+
+class TestFusion:
+    def test_small_groups_fuse_into_super_batch(self):
+        """ISSUE case: buckets (16, 23, 32), ks (9, 3, 2) on D=8 —
+        the k=9 group K-pads to 10 on 5 devices; the two small
+        groups fuse (k=5, zero K-pad, 5 devices, bucket 32)."""
+        plan = plan_ragged_mesh([16, 23, 32], [9, 3, 2], 8)
+        assert len(plan.entries) == 2
+        e0, e1 = plan.entries
+        assert (e0.group_ids, e0.padded_k, e0.n_devices) == ((0,), 10, 5)
+        assert e1.group_ids == (1, 2) and e1.fused
+        assert (e1.bucket, e1.k_real, e1.padded_k) == (32, 5, 5)
+        assert e1.n_devices == 5 and e1.pad_k == 0
+        assert plan.pad_waste_frac < plan.waste_bound
+
+    def test_fusion_respects_k_budget(self):
+        # 3 + 3 = 6 <= 8 fuses; adding another 3 would hit 9 > 8,
+        # so the third group opens a fresh entry
+        plan = plan_ragged_mesh(
+            [16, 17, 18], [3, 3, 3], 8, fuse_max_rows_frac=0.9
+        )
+        assert [e.group_ids for e in plan.entries] == [(0, 1), (2,)]
+
+    def test_fusion_respects_row_waste_budget(self):
+        """Fusing a bucket-8 k=1 group with a bucket-64 k=1 group
+        would re-pad the small member 8 -> 64: waste
+        1 - (8 + 64)/128 = 0.4375 > 0.25, so they stay separate
+        entries even though fused K = 2 <= D."""
+        plan = plan_ragged_mesh([8, 64], [1, 1], 8)
+        assert [e.group_ids for e in plan.entries] == [(0,), (1,)]
+        loose = plan_ragged_mesh(
+            [8, 64], [1, 1], 8, fuse_max_rows_frac=0.5
+        )
+        assert [e.group_ids for e in loose.entries] == [(0, 1)]
+
+    def test_fused_entry_runs_one_per_device(self):
+        plan = plan_ragged_mesh([16, 23], [2, 3], 8)
+        (e,) = plan.entries
+        assert e.fused and e.n_devices == e.k_real == 5
+        assert e.per_device == 1 and e.pad_k == 0
+
+
+# ---------------------------------------------------------------------------
+# plan invariants
+# ---------------------------------------------------------------------------
+
+
+class TestPlanInvariants:
+    CASE = ([11, 16, 23, 32, 45], [2, 9, 1, 3, 16], 8)
+
+    def test_one_device_plan_is_identity(self):
+        """D=1: one entry per group, no pads, no fusion — the plan
+        IS the host ragged path (bitwise-parity foundation)."""
+        bs, ks, _ = self.CASE
+        plan = plan_ragged_mesh(bs, ks, 1)
+        assert len(plan.entries) == len(bs)
+        for g, e in enumerate(plan.entries):
+            assert e.group_ids == (g,)
+            assert e.padded_k == e.k_real == ks[g]
+            assert e.bucket == bs[g]
+            assert e.n_devices == 1 and e.pad_k == 0 and not e.fused
+        assert plan.pad_waste_frac == 0.0
+
+    def test_entry_buckets_unique_ascending(self):
+        bs, ks, d = self.CASE
+        plan = plan_ragged_mesh(bs, ks, d)
+        ebs = [e.bucket for e in plan.entries]
+        assert ebs == sorted(set(ebs))
+
+    def test_entry_of_group_total_and_order_preserving(self):
+        bs, ks, d = self.CASE
+        plan = plan_ragged_mesh(bs, ks, d)
+        seen = []
+        for g in range(len(bs)):
+            seen.append(plan.entry_of_group(g))
+        assert seen == sorted(seen)  # entries preserve group order
+        covered = [g for e in plan.entries for g in e.group_ids]
+        assert covered == list(range(len(bs)))
+        with pytest.raises(KeyError):
+            plan.entry_of_group(len(bs))
+
+    def test_plan_deterministic(self):
+        bs, ks, d = self.CASE
+        assert plan_ragged_mesh(bs, ks, d) == plan_ragged_mesh(bs, ks, d)
+
+    def test_waste_bound_capped_and_honored(self):
+        bs, ks, d = self.CASE
+        plan = plan_ragged_mesh(bs, ks, d)
+        assert plan.pad_waste_frac < plan.waste_bound <= 1.0
+        one = plan_ragged_mesh(bs, ks, 1)
+        assert one.waste_bound == 1.0  # capped (2/1 would be vacuous)
+
+    def test_summary_round_trips_the_plan_shape(self):
+        bs, ks, d = self.CASE
+        s = plan_ragged_mesh(bs, ks, d).summary()
+        assert s["n_devices"] == d
+        assert s["n_entries"] == len(s["entries"])
+        assert all(
+            set(e) == {"group_ids", "bucket", "k_real", "padded_k",
+                       "n_devices", "fused"}
+            for e in s["entries"]
+        )
+
+    def test_input_validation_typed(self):
+        with pytest.raises(ValueError, match="at least one group"):
+            plan_ragged_mesh([], [], 8)
+        with pytest.raises(ValueError, match="buckets vs"):
+            plan_ragged_mesh([16, 23], [4], 8)
+        with pytest.raises(ValueError, match="n_devices"):
+            plan_ragged_mesh([16], [4], 0)
+        with pytest.raises(ValueError, match="ascending"):
+            plan_ragged_mesh([23, 16], [4, 4], 8)
+        with pytest.raises(ValueError, match=">= 1"):
+            plan_ragged_mesh([16], [0], 8)
+        with pytest.raises(ValueError, match="fuse_max_rows_frac"):
+            plan_ragged_mesh([16], [4], 8, fuse_max_rows_frac=1.0)
+
+
+# ---------------------------------------------------------------------------
+# layout oracle (the deduped divisibility check)
+# ---------------------------------------------------------------------------
+
+
+class TestLayoutOracle:
+    def test_divisible_returns_per_device(self):
+        assert require_divisible_layout(16, 8) == 2
+
+    def test_indivisible_typed_and_names_planner(self):
+        with pytest.raises(SubsetLayoutError) as ei:
+            require_divisible_layout(9, 8)
+        msg = str(ei.value)
+        assert "must be divisible by mesh size" in msg
+        assert "plan_ragged_mesh" in msg
+        assert isinstance(ei.value, ValueError)  # back-compat catch
+
+    def test_what_label_threads_into_message(self):
+        with pytest.raises(SubsetLayoutError, match="chunk_size=5"):
+            require_divisible_layout(5, 2, what="chunk_size")
+
+    def test_fits_layout_predicate(self):
+        assert fits_layout(16, 8)
+        assert not fits_layout(9, 8)
+        assert fits_layout(7, 1)
+        assert not fits_layout(4, 0)
+
+    def test_sub_mesh_prefix_slice(self):
+        mesh = make_mesh(min(jax.device_count(), 8))
+        full = sub_mesh(mesh, len(mesh.devices.flat))
+        assert full is mesh  # same-size returns the parent object
+        if jax.device_count() >= 2:
+            sm = sub_mesh(mesh, 2)
+            assert sm.axis_names == mesh.axis_names
+            assert list(sm.devices.flat) == list(mesh.devices.flat)[:2]
+        with pytest.raises(ValueError):
+            sub_mesh(mesh, 0)
+        with pytest.raises(ValueError):
+            sub_mesh(mesh, len(mesh.devices.flat) + 1)
+
+
+# ---------------------------------------------------------------------------
+# entry partitions + failure domains (tiny host arrays, no programs)
+# ---------------------------------------------------------------------------
+
+
+N = 60
+
+
+def _tiny_padded_partition():
+    rng = np.random.default_rng(7)
+    coords = jnp.asarray(rng.uniform(size=(N, 2)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, size=(N, 1)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(N, 1, 2)), jnp.float32)
+    perm = rng.permutation(N)
+    # sizes 10, 10, 10 -> bucket 11 (x3 subsets); 14, 16 -> bucket 16
+    asg = [perm[:10], perm[10:20], perm[20:30],
+           perm[30:44], perm[44:60]]
+    return padded_partition(y, x, coords, asg)
+
+
+class TestEntryPartition:
+    def test_identity_entry_returns_group_stack_object(self):
+        pp = _tiny_padded_partition()
+        plan = plan_ragged_mesh(
+            list(pp.buckets),
+            [len(g.subset_ids) for g in pp.groups],
+            1,
+        )
+        for g, e in enumerate(plan.entries):
+            stack, ids = ragged_mesh_entry_partition(pp, e)
+            assert stack is pp.groups[g].part  # the SAME object
+            assert ids == list(pp.groups[g].subset_ids)
+
+    def test_kpad_clones_first_real_subset(self):
+        pp = _tiny_padded_partition()
+        # group 0: bucket 11, k=3 on D=2 -> padded_k=4, one clone
+        plan = plan_ragged_mesh(
+            list(pp.buckets),
+            [len(g.subset_ids) for g in pp.groups],
+            2,
+        )
+        e = plan.entries[0]
+        assert (e.k_real, e.padded_k) == (3, 4)
+        stack, ids = ragged_mesh_entry_partition(pp, e)
+        assert ids == [0, 1, 2]  # real rows only
+        assert stack.mask.shape == (4, 11)
+        for leaf in stack:
+            assert jnp.array_equal(leaf[3], leaf[0])  # clone of row 0
+
+    def test_fused_entry_repads_m_axis_with_pad_identity(self):
+        pp = _tiny_padded_partition()
+        plan = plan_ragged_mesh(
+            list(pp.buckets),
+            [len(g.subset_ids) for g in pp.groups],
+            8,
+            fuse_max_rows_frac=0.5,
+        )
+        (e,) = plan.entries
+        assert e.fused and e.bucket == 16 and e.k_real == 5
+        stack, ids = ragged_mesh_entry_partition(pp, e)
+        assert ids == [0, 1, 2, 3, 4]
+        assert stack.mask.shape == (5, 16)
+        # re-padded rows of the bucket-11 members carry the pad
+        # identity: mask 0, index -1, zeroed y
+        ext = stack.mask[:3, 11:]
+        assert float(jnp.sum(ext)) == 0.0
+        assert jnp.all(stack.index[:3, 11:] == -1)
+        assert float(jnp.sum(jnp.abs(stack.y[:3, 11:]))) == 0.0
+        # original member content untouched
+        g0 = pp.groups[0].part
+        assert jnp.array_equal(stack.y[:3, :11], g0.y)
+
+    def test_failure_domain_map_follows_plan_layout(self):
+        if jax.device_count() < 8:
+            pytest.skip("needs 8 virtual devices")
+        pp = _tiny_padded_partition()
+        mesh = make_mesh(8)
+        plan = plan_ragged_mesh(
+            list(pp.buckets),
+            [len(g.subset_ids) for g in pp.groups],
+            8,
+            fuse_max_rows_frac=0.5,
+        )
+        dmap = FailureDomainMap.derive_ragged(plan, pp, mesh)
+        assert dmap.k == pp.n_subsets
+        # fused super-batch runs 1-per-device on a 5-device prefix:
+        # global subset j sits on device j -> 5 distinct domains
+        assert dmap.n_domains == 5
+        assert dmap.domains_of(range(pp.n_subsets)) == [0, 1, 2, 3, 4]

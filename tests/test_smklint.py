@@ -1363,10 +1363,103 @@ class TestBoundedCoalesceWait:
         assert "SMK116" in rules_hit(broken, path=COALESCE_PATH)
 
 
+class TestDeviceLayout:
+    """SMK117 (ISSUE 17): ad-hoc device-count divisibility / layout
+    arithmetic outside the planner (compile/buckets) and the executor
+    oracle zone is banned — callers must route through
+    require_divisible_layout / fits_layout / plan_ragged_mesh."""
+
+    def test_modulo_and_floordiv_by_device_count_flagged(self):
+        src = (
+            "def f(k, n_devices):\n"
+            "    if k % n_devices != 0:\n"
+            "        raise ValueError()\n"
+            "    return k // n_devices\n"
+        )
+        hits = lines_hit(src, "SMK117")
+        assert hits == [2, 4]
+
+    def test_mesh_size_chain_and_device_count_call_flagged(self):
+        src = (
+            "import jax\n"
+            "def f(k, mesh):\n"
+            "    a = k % mesh.devices.size\n"
+            "    b = k % jax.device_count()\n"
+            "    c = k % int(mesh.devices.size)\n"
+            "    return a, b, c\n"
+        )
+        assert lines_hit(src, "SMK117") == [3, 4, 5]
+
+    def test_ceil_to_multiple_and_neg_floordiv_idioms_flagged(self):
+        src = (
+            "import math\n"
+            "def f(k, n_dev, mesh):\n"
+            "    a = ((k + n_dev - 1) // n_dev) * n_dev\n"
+            "    b = math.ceil(k / n_dev)\n"
+            "    c = -(-k // int(mesh.devices.size))\n"
+            "    return a, b, c\n"
+        )
+        assert "SMK117" in rules_hit(src)
+        assert len(lines_hit(src, "SMK117")) == 3
+
+    def test_ceil_alias_import_flagged(self):
+        src = (
+            "from math import ceil as c\n"
+            "def h(k, n_dev):\n"
+            "    return c(k / n_dev)\n"
+        )
+        assert "SMK117" in rules_hit(src)
+
+    def test_non_device_divisors_clean(self):
+        # chunk_size / n_bins / n_subsets arithmetic is fine — the
+        # rule keys on device-count spellings only
+        src = (
+            "import math\n"
+            "def g(k, chunk_size, n_bins):\n"
+            "    a = k % chunk_size\n"
+            "    b = k // n_bins\n"
+            "    c = math.ceil(k / chunk_size)\n"
+            "    return a, b, c\n"
+        )
+        assert "SMK117" not in rules_hit(src)
+
+    def test_planner_and_executor_zones_exempt(self):
+        src = "def f(k, n_devices):\n    return k % n_devices\n"
+        for zone in (
+            "smk_tpu/parallel/executor.py",
+            "smk_tpu/compile/buckets.py",
+        ):
+            assert "SMK117" not in rules_hit(src, path=zone), zone
+
+    def test_outside_smk_tpu_clean(self):
+        src = "def f(k, n_devices):\n    return k % n_devices\n"
+        assert "SMK117" not in rules_hit(src, path=SCRIPT_PATH)
+        assert "SMK117" not in rules_hit(src, path=TESTS_PATH)
+
+    def test_suppression_with_justification(self):
+        src = (
+            "def f(k, n_devices):\n"
+            "    return k % n_devices  "
+            "# smklint: disable=SMK117 -- display-only shard count\n"
+        )
+        hits = rules_hit(src)
+        assert "SMK117" not in hits and "SMK100" not in hits
+
+    def test_real_recovery_clean_and_seeded_defect_caught(self):
+        real = "smk_tpu/parallel/recovery.py"
+        src = repo_file(real)
+        assert "SMK117" not in rules_hit(src, path=real)
+        broken = src + (
+            "\n\ndef _pad_naive(k, n_dev):\n"
+            "    return (k + n_dev - 1) // n_dev\n"
+        )
+        assert "SMK117" in rules_hit(broken, path=real)
+
+
 @pytest.mark.parametrize("rule_id", [
     "SMK101", "SMK102", "SMK103", "SMK104", "SMK105", "SMK106",
     "SMK107", "SMK108", "SMK109", "SMK110", "SMK111", "SMK112",
-    "SMK113", "SMK114", "SMK115", "SMK116",
+    "SMK113", "SMK114", "SMK115", "SMK116", "SMK117",
 ])
 def test_every_rule_documented_in_catalogue(rule_id):
     from smk_tpu.analysis.lint import _list_rules
