@@ -1427,6 +1427,119 @@ def run_rung_ragged(name, *, solver_env=None, n=None, k=None,
     }
 
 
+def run_rung_adaptive(name, *, solver_env=None, n=None, k=None,
+                      n_samples=None, n_test=32):
+    """BENCH_ADAPTIVE=1 (ISSUE 18): the adaptive-compute A/B cell.
+
+    The SAME public fit runs twice — adaptive_schedule="off" (the
+    fixed chunk schedule, the baseline every prior bench record
+    measured) and "on" (per-subset early stopping + active-set
+    compaction + straggler budget reallocation,
+    parallel/schedule.AdaptiveScheduler). The record stamps both
+    walls, the baseline ``ess_per_second`` next to the adaptive
+    run's ``ess_per_second_adaptive`` (same convergence-adjusted
+    numerator — saved chunks must buy throughput, not mixing), and
+    the scheduler's own accounting: ``chunks_saved_frac`` (strictly
+    positive when any subset froze early), ``frozen_at`` and
+    ``extra_granted``. BENCH_ADAPTIVE_N / BENCH_ADAPTIVE_K /
+    BENCH_ADAPTIVE_ITERS resize; BENCH_TARGET_RHAT /
+    BENCH_TARGET_ESS / BENCH_ADAPT_FRAC tune the stopping targets
+    (scripts/adaptive_probe.py is the subprocess-isolated protocol
+    sibling emitting ADAPT_r19.jsonl)."""
+    import dataclasses
+
+    from smk_tpu.api import fit_meta_kriging
+    from smk_tpu.utils.tracing import ChunkPipelineStats, device_sync
+
+    env = solver_env or {}
+    n = n or int(os.environ.get("BENCH_ADAPTIVE_N", 1024))
+    k = k or int(os.environ.get("BENCH_ADAPTIVE_K", 8))
+    n_samples = n_samples or int(
+        os.environ.get("BENCH_ADAPTIVE_ITERS", 240)
+    )
+    n_all = n + n_test
+    y, x, coords = make_binary_field(jax.random.key(3), n_all)
+    y, x, coords, coords_test, x_test = (
+        y[:n], x[:n], coords[:n], coords[n:], x[n:],
+    )
+    base = rung_config(
+        env, k=k, n_samples=n_samples,
+        cov_model="exponential", link="probit", n_chains=2,
+    )
+    base = dataclasses.replace(base, live_diagnostics=True)
+    kept = base.n_samples - base.n_burn_in
+    chunk_iters = int(
+        env.get("BENCH_CHUNK_ITERS", max(10, kept // 8))
+    )
+    adaptive = dataclasses.replace(
+        base,
+        adaptive_schedule="on",
+        target_rhat=float(os.environ.get("BENCH_TARGET_RHAT", 1.2)),
+        target_ess=float(os.environ.get("BENCH_TARGET_ESS", 50.0)),
+        adapt_patience=int(os.environ.get("BENCH_ADAPT_PATIENCE", 2)),
+        min_samples_before_stop=int(
+            os.environ.get("BENCH_ADAPT_MIN", max(1, kept // 4))
+        ),
+        adapt_max_extra_frac=float(
+            os.environ.get("BENCH_ADAPT_FRAC", 0.5)
+        ),
+    )
+    out = {
+        "rung": name, "n": n, "K": k, "iters": n_samples,
+        "public_path": True, "chunk_iters": chunk_iters,
+        "target_rhat": adaptive.target_rhat,
+        "target_ess": adaptive.target_ess,
+    }
+    for arm, cfg in (("off", base), ("on", adaptive)):
+        pstats = ChunkPipelineStats()
+        t0 = time.time()
+        res = fit_meta_kriging(
+            jax.random.key(2), y, x, coords, coords_test, x_test,
+            config=cfg, chunk_iters=chunk_iters,
+            pipeline_stats=pstats,
+        )
+        device_sync((res.param_grid, res.p_quant))
+        wall = time.time() - t0
+        agg = pstats.aggregate()
+        if arm == "off":
+            out.update(
+                wall_s_off=round(wall, 2),
+                ess_per_second=agg["ess_per_second"],
+            )
+        else:
+            out.update(
+                wall_s_adaptive=round(wall, 2),
+                ess_per_second_adaptive=agg[
+                    "ess_per_second_adaptive"
+                ],
+                chunks_saved_frac=agg["chunks_saved_frac"],
+                frozen_at=agg["frozen_at"],
+                extra_granted=(
+                    pstats.adaptive["extra_granted"]
+                    if pstats.adaptive else None
+                ),
+                subset_chunks_dispatched=(
+                    pstats.adaptive["subset_chunks_dispatched"]
+                    if pstats.adaptive else None
+                ),
+                subset_chunks_baseline=(
+                    pstats.adaptive["subset_chunks_baseline"]
+                    if pstats.adaptive else None
+                ),
+                # the result-surface mirrors (api.MetaKrigingResult)
+                result_frozen_at=(
+                    list(res.frozen_at)
+                    if res.frozen_at is not None else None
+                ),
+                result_chunks_saved_frac=res.chunks_saved_frac,
+            )
+        out[f"finite_{arm}"] = bool(
+            np.isfinite(np.asarray(res.p_quant)).all()
+            and np.isfinite(np.asarray(res.param_grid)).all()
+        )
+    return out
+
+
 def run_rung(name, *, n, k, cov_model, n_samples, q=1, p=2, n_test=64,
              seed=0, solver_env=None, make_data=None, link="probit",
              budget_left=None, progress=None):
@@ -2541,6 +2654,24 @@ def main():
         except Exception as e:
             reporter.ladder.append(
                 {"rung": "ragged_coherent", "error": repr(e)}
+            )
+            reporter.emit(partial=True)
+
+    # Adaptive-compute rung (ISSUE 18): BENCH_ADAPTIVE=1 appends the
+    # A/B cell — the same model fit with the fixed schedule and with
+    # adaptive_schedule="on", stamping ess_per_second for both arms
+    # plus chunks_saved_frac / frozen_at / extra_granted for the
+    # adaptive arm (scripts/adaptive_probe.py is the correctness
+    # sibling emitting ADAPT_r19.jsonl). Reporter-first fallible like
+    # every probe cell.
+    if os.environ.get("BENCH_ADAPTIVE", "0") == "1":
+        try:
+            reporter.add_rung(run_rung_adaptive(
+                "adaptive_ab", solver_env=env,
+            ))
+        except Exception as e:
+            reporter.ladder.append(
+                {"rung": "adaptive_ab", "error": repr(e)}
             )
             reporter.emit(partial=True)
 

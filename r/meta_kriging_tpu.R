@@ -65,6 +65,10 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
                                                      "coherent"),
                                 bucket.ladder = NULL,
                                 chunk.pipeline = c("sync", "overlap"),
+                                adaptive.schedule = c("off", "on"),
+                                target.rhat = 1.05,
+                                target.ess = 100,
+                                adapt.max.extra.frac = 0.5,
                                 fault.policy = c("abort", "quarantine"),
                                 fault.max.retries = 2L,
                                 watchdog = FALSE,
@@ -209,11 +213,25 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
   # bucket.ladder: optional explicit ladder (ascending integer
   # vector) for the coherent path; NULL = the automatic sqrt(2)
   # ladder covering the largest subset.
+  # adaptive.schedule: per-subset early stopping (ISSUE 18). "off"
+  # (default) is the fixed chunk schedule, bit-identical to every
+  # prior release. "on" freezes each subset once its STREAMING
+  # cross-chain diagnostics clear target.rhat AND target.ess for a
+  # patience window, compacts the active set onto the next
+  # sqrt(2)-ladder rung, and regrants the saved chunk budget to the
+  # slowest-mixing subsets (at most adapt.max.extra.frac x n.samples
+  # extra draws per subset). Needs n_chains >= 2 via
+  # config.overrides for real cross-chain R-hat. The fit returns
+  # $frozen.at (per-subset freeze iteration, -1 = never froze) and
+  # $chunks.saved.frac (fraction of the fixed schedule's
+  # subset-chunks NOT dispatched); both NULL when "off". See the
+  # README's "Adaptive compute" section.
   k.prior <- match.arg(k.prior)
   phi.proposal.family <- match.arg(phi.proposal.family)
   fused.build <- match.arg(fused.build)
   partition.method <- match.arg(partition.method)
   chunk.pipeline <- match.arg(chunk.pipeline)
+  adaptive.schedule <- match.arg(adaptive.schedule)
   fault.policy <- match.arg(fault.policy)
   # link: the reference workflow is logit (spMvGLM binomial fit,
   # 1/(1+exp(-eta)) at MetaKriging_BinaryResponse.R:160); the TPU
@@ -267,6 +285,10 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
     bucket_ladder = if (is.null(bucket.ladder)) NULL else
       as.integer(bucket.ladder),
     chunk_pipeline = chunk.pipeline,
+    adaptive_schedule = adaptive.schedule,
+    target_rhat = target.rhat,
+    target_ess = target.ess,
+    adapt_max_extra_frac = adapt.max.extra.frac,
     fault_policy = fault.policy,
     fault_max_retries = as.integer(fault.max.retries),
     watchdog = watchdog,
@@ -335,6 +357,13 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
     # pad_waste_frac under n.devices (< min(1, max(0.25,
     # 2/n.devices))), NULL for equal-m partitions (ISSUE 17)
     pad.waste.frac = res$pad_waste_frac,
+    # adaptive schedule (ISSUE 18): per-subset freeze iteration
+    # (-1 = sampled the full plan) and the fraction of the fixed
+    # schedule's subset-chunks the scheduler did NOT dispatch;
+    # both NULL when adaptive.schedule = "off"
+    frozen.at = if (is.null(res$frozen_at)) NULL else
+      as.integer(unlist(res$frozen_at)),
+    chunks.saved.frac = res$chunks_saved_frac,
     param.names = unlist(smk$api$param_names(as.integer(q), as.integer(p)))
   )
 }

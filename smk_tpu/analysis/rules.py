@@ -2079,6 +2079,118 @@ class DeviceLayoutRule(Rule):
                     yield self.finding(module, node, msg)
 
 
+# ---------------------------------------------------------------------------
+# SMK118 — schedule discipline (one early-stop decision function)
+# ---------------------------------------------------------------------------
+
+# The sanctioned readers of the adaptive decision knobs (ISSUE 18):
+# the scheduler itself (its __init__ is THE knob-read site) and the
+# config module that defines/validates them.
+_SCHEDULE_ZONES = (
+    "smk_tpu/parallel/schedule",
+    "smk_tpu/config",
+)
+
+# Modules allowed to construct an AdaptiveScheduler: the chunked
+# executor (the one consult site) and the warm-path precompiler
+# (ladder geometry — it never calls observe()).
+_SCHEDULER_CTOR_ZONES = (
+    "smk_tpu/parallel/schedule",
+    "smk_tpu/parallel/recovery",
+    "smk_tpu/compile/warmup",
+)
+
+# The decision knobs. `adaptive_schedule` itself is NOT here — it is
+# the on/off gate, and gating dispatch on it is exactly what callers
+# are supposed to do.
+_SCHEDULE_KNOBS = {
+    "target_rhat",
+    "target_ess",
+    "adapt_patience",
+    "min_samples_before_stop",
+    "adapt_max_extra_frac",
+}
+
+
+class ScheduleDisciplineRule(Rule):
+    id = "SMK118"
+    name = "schedule-discipline"
+    doc = (
+        "adaptive early-stop decision logic outside "
+        "parallel/schedule.py — reads of the decision knobs "
+        "(`target_rhat`, `target_ess`, `adapt_patience`, "
+        "`min_samples_before_stop`, `adapt_max_extra_frac`) in "
+        "smk_tpu/ library code outside parallel/schedule.py and "
+        "config.py, `.observe(...)` consults outside "
+        "parallel/recovery.py (the chunked executor owns the ONE "
+        "consult site), and `AdaptiveScheduler(...)` construction "
+        "outside recovery/warmup. A second decision site is how "
+        "freeze/compaction decisions stop being a pure replayable "
+        "function of the committed boundary stats: the kill/resume "
+        "identity and the off-mode golden pin both depend on every "
+        "decision flowing through AdaptiveScheduler.observe "
+        "(ISSUE 18)"
+    )
+
+    def applies(self, module):
+        return "smk_tpu/" in module.norm_path()
+
+    def check(self, module, ctx):
+        norm = module.norm_path()
+        in_sched_zone = any(z in norm for z in _SCHEDULE_ZONES)
+        in_ctor_zone = any(z in norm for z in _SCHEDULER_CTOR_ZONES)
+        in_executor = "smk_tpu/parallel/recovery" in norm
+        knob_msg = (
+            "adaptive decision knob read outside parallel/schedule.py "
+            "— the scheduler's __init__ is the one sanctioned reader; "
+            "a second reader is a second early-stop policy waiting to "
+            "drift from the replayable one (SMK118 "
+            "schedule-discipline)"
+        )
+        consult_msg = (
+            "AdaptiveScheduler consult outside the chunked executor — "
+            "parallel/recovery.py owns the ONE observe() site (every "
+            "decision must be a pure function of COMMITTED boundary "
+            "stats, sidecar-persisted for kill/resume identity); "
+            "route new signals through the executor's boundary record "
+            "(SMK118 schedule-discipline)"
+        )
+        ctor_msg = (
+            "AdaptiveScheduler constructed outside "
+            "parallel/recovery.py / compile/warmup.py — a scheduler "
+            "instance whose decisions do not flow through the "
+            "executor's committed boundaries cannot be replayed from "
+            "the sidecar (SMK118 schedule-discipline)"
+        )
+        for node in ast.walk(module.tree):
+            if (
+                not in_sched_zone
+                and isinstance(node, ast.Attribute)
+                and isinstance(node.ctx, ast.Load)
+                and node.attr in _SCHEDULE_KNOBS
+            ):
+                yield self.finding(module, node, knob_msg)
+            elif isinstance(node, ast.Call):
+                chain = attr_chain(node.func)
+                if not chain:
+                    continue
+                if (
+                    not in_executor
+                    and not in_sched_zone
+                    and len(chain) >= 2
+                    and chain[-1] == "observe"
+                    and any(
+                        "sched" in part.lower() for part in chain[:-1]
+                    )
+                ):
+                    yield self.finding(module, node, consult_msg)
+                elif (
+                    not in_ctor_zone
+                    and chain[-1] == "AdaptiveScheduler"
+                ):
+                    yield self.finding(module, node, ctor_msg)
+
+
 ALL_RULES = [
     BatchingRuleRule(),
     HostNondeterminismRule(),
@@ -2097,4 +2209,5 @@ ALL_RULES = [
     LadderDisciplineRule(),
     BoundedCoalesceWaitRule(),
     DeviceLayoutRule(),
+    ScheduleDisciplineRule(),
 ]

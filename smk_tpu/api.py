@@ -93,6 +93,15 @@ class MetaKrigingResult(NamedTuple):
         planner's documented ``waste_bound``). 0.0 for a ragged fit
         off-mesh or on 1 device (the plan is the identity); None for
         equal-m fits (no plan exists).
+    frozen_at : per-subset global iteration at which the adaptive
+        scheduler froze each subset (ISSUE 18,
+        ``config.adaptive_schedule="on"``): a K-tuple, -1 where the
+        subset ran its full (possibly extended) schedule. None on
+        fixed-schedule fits.
+    chunks_saved_frac : fraction of the fixed schedule's dispatched
+        subset-chunks the adaptive run did NOT dispatch (net of
+        straggler extras — can be negative when reallocation
+        dominates). None on fixed-schedule fits.
     """
 
     param_grid: jnp.ndarray
@@ -115,6 +124,8 @@ class MetaKrigingResult(NamedTuple):
     run_log_path: Optional[str] = None
     domains_dropped: tuple = ()
     pad_waste_frac: Optional[float] = None
+    frozen_at: Optional[tuple] = None
+    chunks_saved_frac: Optional[float] = None
 
 
 def param_names(q: int, p: int) -> list[str]:
@@ -965,5 +976,19 @@ def _fit_meta_kriging_impl(
             else (
                 0.0 if isinstance(part, PaddedPartition) else None
             )
+        ),
+        # ISSUE 18 adaptive-compute ledger (None on fixed schedules):
+        # stamped by the chunked executor into the pipeline stats
+        frozen_at=(
+            tuple(pipeline_stats.adaptive["frozen_at"])
+            if pipeline_stats is not None
+            and getattr(pipeline_stats, "adaptive", None)
+            else None
+        ),
+        chunks_saved_frac=(
+            pipeline_stats.adaptive["chunks_saved_frac"]
+            if pipeline_stats is not None
+            and getattr(pipeline_stats, "adaptive", None)
+            else None
         ),
     )

@@ -195,6 +195,49 @@ def pad_accounting(
     }
 
 
+def k_ladder(max_k: int) -> Tuple[int, ...]:
+    """The K-axis compaction ladder (ISSUE 18, adaptive schedules):
+    √2 rungs from a single subset up to the run's full K, capped at
+    K itself — ``bucket_ladder(max_k, min_bucket=1)`` with the top
+    rung clamped so the uncompacted dispatch group is always a rung
+    (its programs are the run's ordinary full-K programs). K is a
+    component of every L1/L2 program-store bucket key, so each rung
+    resolves its own stored program set and
+    ``warmup.precompile(adaptive=True)`` can pre-warm the whole
+    ladder."""
+    rungs = [min(int(r), int(max_k)) for r in bucket_ladder(max_k, min_bucket=1)]
+    out: List[int] = []
+    for r in rungs:
+        if not out or r > out[-1]:
+            out.append(r)
+    return tuple(out)
+
+
+def compaction_rung(n_active: int, k: int, n_devices: int = 1) -> int:
+    """Dispatch-group size for ``n_active`` surviving subsets of an
+    original-K adaptive run: the smallest :func:`k_ladder` rung
+    holding them, rounded up to a device multiple under a mesh (the
+    compacted group must keep the run mesh's device set — an
+    accumulator scatter cannot span two device assignments), and
+    capped at K. The gap ``rung - n_active`` is padded with clones of
+    the first active subset whose outputs the executor drops
+    (``pad_waste_frac`` accounting stays honest — the executor
+    reports it per compaction event)."""
+    if not 1 <= n_active <= k:
+        raise ValueError(
+            f"n_active must be in [1, {k}], got {n_active}"
+        )
+    if n_devices < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n_devices}")
+    if k % n_devices != 0:
+        raise ValueError(
+            f"K={k} not divisible by n_devices={n_devices} — the "
+            "uncompacted run would already violate the layout oracle"
+        )
+    rung = bucket_for(n_active, k_ladder(k))
+    return min(ceil_to_multiple(rung, n_devices), k)
+
+
 def ceil_to_multiple(n: int, multiple: int) -> int:
     """Round ``n`` up to the nearest multiple of ``multiple``. The
     one sanctioned ceil-to-multiple spelling (smklint SMK117): K-axis

@@ -107,6 +107,18 @@ _DIGEST_NEUTRAL = dict(
     # their own variant kind ("serve_predict" vs "serve_predict_rs"),
     # and no fit program ever sees the knob
     coalesce_window_ms=0.0,
+    # adaptive-schedule knobs (ISSUE 18, parallel/schedule.py): pure
+    # host-side scheduling — which (kind, length, K-rung) programs
+    # get DISPATCHED, never what any of them computes — so one warm
+    # K-ladder store serves fixed and adaptive runs alike (the
+    # checkpoint run identity still covers them: cross-policy resume
+    # is rejected there, not here)
+    adaptive_schedule="off",
+    target_rhat=1.05,
+    target_ess=100.0,
+    adapt_patience=2,
+    min_samples_before_stop=0,
+    adapt_max_extra_frac=0.5,
 )
 
 

@@ -441,6 +441,132 @@ def precompile(
             stats=rec,
         )
 
+    if getattr(cfg, "adaptive_schedule", "off") == "on":
+        # ISSUE 18: pre-warm the K'-compaction ladder. An adaptive fit
+        # re-dispatches at the sqrt-2 rung covering the surviving
+        # active set, so every reachable rung's sampling-chunk /
+        # stats / refork programs — plus the full-K masked finalize —
+        # must be in the store or the FIRST freeze would compile on
+        # the hot path (recompile_guard-pinned zero in
+        # scripts/adaptive_probe.py).
+        from smk_tpu.compile.buckets import compaction_rung
+        from smk_tpu.compile.programs import aux_bucket_key
+        from smk_tpu.parallel.schedule import AdaptiveScheduler
+
+        n_dev = mesh.devices.size if mesh is not None else 1
+        sched_geom = AdaptiveScheduler(
+            cfg, k=k, n_kept=cfg.n_kept, chunk_iters=chunk_iters,
+            n_devices=n_dev,
+        )
+        n_cap = sched_geom.n_cap
+
+        def relead(a, kk):
+            sharding = (
+                a.sharding if isinstance(a, jax.ShapeDtypeStruct)
+                else None
+            )
+            if sharding is not None:
+                return jax.ShapeDtypeStruct(
+                    (kk,) + tuple(a.shape[1:]), a.dtype,
+                    sharding=sharding,
+                )
+            return jax.ShapeDtypeStruct(
+                (kk,) + tuple(a.shape[1:]), a.dtype
+            )
+
+        samp_lengths = [
+            n for kind, n in chunk_plan_lengths(
+                cfg.n_burn_in, cfg.n_samples, chunk_iters
+            )
+            if kind == "samp"
+        ]
+        rungs = sorted(
+            {compaction_rung(na, k, n_dev) for na in range(1, k + 1)}
+            - {k}
+        )
+        for kk in rungs:
+            data_kk = data._replace(
+                coords=relead(data.coords, kk),
+                x=relead(data.x, kk),
+                y=relead(data.y, kk),
+                mask=relead(data.mask, kk),
+            )
+            state_kk = jax.tree_util.tree_map(
+                lambda s: relead(s, kk), state_like
+            )
+            for n in samp_lengths:
+                get_program(
+                    model,
+                    _rec._chunk_key(
+                        model, "samp", n, kk, None, m, q, p, t,
+                        d_coord, mesh=mesh,
+                    ),
+                    lambda kk=kk, n=n: _rec._make_chunk_fn(
+                        model, "samp", n, kk, None,
+                        out_sharding=shard,
+                    ),
+                    store=store, lower_args=(data_kk, state_kk, it0),
+                    stats=rec,
+                )
+            get_program(
+                model, _rec._stats_key(model, kk, m, q, p, mesh=mesh),
+                lambda: _rec._chunk_stats,
+                store=store, lower_args=(state_kk,), stats=rec,
+            )
+            if cfg.fault_policy == "quarantine":
+                get_program(
+                    model,
+                    _rec._refork_key(model, kk, m, q, p, mesh=mesh),
+                    lambda: _rec._make_refork(
+                        cfg.n_chains, out_sharding=shard
+                    ),
+                    store=store,
+                    lower_args=(
+                        state_kk,
+                        like(
+                            jax.ShapeDtypeStruct((kk,), np.bool_),
+                            repl,
+                        ),
+                        like(
+                            jax.ShapeDtypeStruct((kk,), np.int32),
+                            repl,
+                        ),
+                    ),
+                    stats=rec,
+                )
+        # the masked finalize consumes the CAPACITY-sized accumulators
+        # (base kept draws + worst-case extra allowance) at full K
+        get_program(
+            model,
+            aux_bucket_key(
+                model, "finadapt", k, m, q, n_cap, d_par, d_w,
+                mesh=mesh,
+            ),
+            lambda: (
+                jax.jit(
+                    jax.vmap(model.finalize_masked),
+                    out_shardings=shard,
+                )
+                if shard is not None
+                else jax.jit(jax.vmap(model.finalize_masked))
+            ),
+            store=store,
+            lower_args=(
+                state_like,
+                like(
+                    jax.ShapeDtypeStruct(lead + (n_cap, d_par), dtype),
+                    shard,
+                ),
+                like(
+                    jax.ShapeDtypeStruct(lead + (n_cap, d_w), dtype),
+                    shard,
+                ),
+                like(jax.ShapeDtypeStruct((k, n_cap), np.bool_), shard),
+                like(jax.ShapeDtypeStruct((k,), np.int32), shard),
+            ),
+            stats=rec,
+        )
+
     programs = rec.programs[n_before:]
     return {
         "store_dir": store.root if store is not None else None,

@@ -22,6 +22,7 @@ COMBINERS = ("wasserstein_mean", "weiszfeld_median")
 PHI_PROPOSAL_FAMILIES = ("gaussian", "student_t", "mixture")
 CHUNK_PIPELINES = ("sync", "overlap")
 FAULT_POLICIES = ("abort", "quarantine")
+ADAPTIVE_SCHEDULES = ("off", "on")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -534,6 +535,36 @@ class SMKConfig:
     profile_dir: str = None
     profile_chunks: str = None
 
+    # Adaptive compute (ISSUE 18; parallel/schedule.py): per-subset
+    # early stopping with active-set compaction and straggler budget
+    # reallocation. "off" (default) is golden-pinned bit-identical to
+    # the fixed schedule. "on" arms an AdaptiveScheduler the chunked
+    # executor consults at every committed sampling boundary: a
+    # subset whose STREAMING diagnostics clear target_rhat AND
+    # target_ess for adapt_patience consecutive boundaries (after at
+    # least min_samples_before_stop kept draws) FREEZES — it leaves
+    # the dispatch group at the next √2-ladder rung shrink
+    # (compile/buckets.py owns the rung math; surviving chains are
+    # bit-identical to their uncompacted selves) — and the freed
+    # subset-chunk budget funds extra sampling chunks for the
+    # stragglers (worst R-hat first), capped at
+    # adapt_max_extra_frac x n_samples extra iterations per subset.
+    # All decisions are pure functions of committed-boundary
+    # statistics: same seed + config => identical schedule, including
+    # across kill/resume (the schedule state persists next to the
+    # checkpoint manifest). Requires live_diagnostics=True and the
+    # "sync" pipeline (decisions and compaction happen with the
+    # device idle at the boundary); the knobs are digest-neutral for
+    # the compile store (one warm store serves off AND on) but enter
+    # the checkpoint run identity, so cross-policy resume is
+    # rejected.
+    adaptive_schedule: str = "off"
+    target_rhat: float = 1.05
+    target_ess: float = 100.0
+    adapt_patience: int = 2
+    min_samples_before_stop: int = 0
+    adapt_max_extra_frac: float = 0.5
+
     # Blocked-GEMM Cholesky for the phi-MH proposal factorization (the
     # one remaining O(m^3) kernel): 0 = XLA's native cholesky; > 0 =
     # ops/chol.py blocked_cholesky with this block size (the same
@@ -622,6 +653,7 @@ class SMKConfig:
         "cg_iters", "cg_precond_rank", "chol_block_size",
         "trisolve_block_size", "pg_n_terms", "phi_proposals",
         "fault_max_retries", "dist_init_retries",
+        "adapt_patience", "min_samples_before_stop",
     )
 
     def __post_init__(self):
@@ -749,6 +781,39 @@ class SMKConfig:
                 "live_diagnostics must be a bool, got "
                 f"{self.live_diagnostics!r}"
             )
+        if self.adaptive_schedule not in ADAPTIVE_SCHEDULES:
+            raise ValueError(
+                "adaptive_schedule must be one of "
+                f"{ADAPTIVE_SCHEDULES}"
+            )
+        if self.adaptive_schedule != "off":
+            if not self.live_diagnostics:
+                raise ValueError(
+                    "adaptive_schedule='on' requires "
+                    "live_diagnostics=True — freeze decisions are "
+                    "pure functions of the streaming boundary "
+                    "diagnostics (parallel/schedule.py)"
+                )
+            if self.chunk_pipeline != "sync":
+                raise ValueError(
+                    "adaptive_schedule='on' requires "
+                    "chunk_pipeline='sync' — schedule decisions and "
+                    "active-set compaction happen with the device "
+                    "idle at the committed boundary"
+                )
+        if self.target_rhat <= 1.0:
+            raise ValueError(
+                "target_rhat must be > 1 (split-R-hat converges to "
+                "1 from above)"
+            )
+        if self.target_ess < 0:
+            raise ValueError("target_ess must be >= 0")
+        if self.adapt_patience < 1:
+            raise ValueError("adapt_patience must be >= 1")
+        if self.min_samples_before_stop < 0:
+            raise ValueError("min_samples_before_stop must be >= 0")
+        if self.adapt_max_extra_frac < 0:
+            raise ValueError("adapt_max_extra_frac must be >= 0")
         if self.profile_chunks is not None:
             if not isinstance(self.profile_chunks, str):
                 raise ValueError(

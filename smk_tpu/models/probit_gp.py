@@ -1685,6 +1685,70 @@ class SpatialGPSampler:
             w_rhat=rhat(chains_w),
         )
 
+    def finalize_masked(
+        self, state, param_draws, w_draws, row_mask, it_end
+    ) -> SubsetResult:
+        """``finalize`` over a capacity-padded draw buffer (ISSUE 18).
+
+        Adaptive schedules freeze subsets early and grant stragglers
+        extra chunks, so per-subset kept counts differ while the draw
+        buffers stay at one shared capacity. ``row_mask`` (n_cap,)
+        flags the per-chain rows that hold real draws (shared across
+        chains — chains advance in lockstep); ``it_end`` is the global
+        iteration (exclusive) at which this subset left the dispatch
+        group, which sets the phi-acceptance divisor (phi proposals
+        keep running until the subset physically leaves the group).
+        Both may be traced, so ONE jit of vmap(finalize_masked) serves
+        every subset regardless of when it froze.
+
+        ``param_samples`` / ``w_samples`` come back at capacity with
+        invalid rows zeroed — consumers slice by the result's
+        ``frozen_at`` counts (api.MetaKrigingResult).
+        """
+        from smk_tpu.ops.quantiles import masked_quantile_grid
+        from smk_tpu.utils.diagnostics import (
+            masked_effective_sample_size,
+            masked_rhat,
+        )
+
+        cfg = self.config
+        e = cfg.phi_update_every
+        it_end = jnp.asarray(it_end, jnp.int32)
+        # multiples of e in [n_burn_in, it_end) — closed form so it
+        # stays traced; matches finalize's python loop when
+        # it_end == n_samples.
+        n_upd = (it_end + e - 1) // e - (cfg.n_burn_in + e - 1) // e
+        n_upd = jnp.maximum(n_upd, 1)
+        chains_p = param_draws[None] if param_draws.ndim == 2 else param_draws
+        chains_w = w_draws[None] if w_draws.ndim == 2 else w_draws
+        c_ch = chains_p.shape[0]
+        dt = chains_p.dtype
+        row_mask = jnp.asarray(row_mask, bool)
+        pooled_mask = jnp.tile(row_mask, c_ch)  # chain-major pooling
+        pooled_p = chains_p.reshape(-1, chains_p.shape[-1])
+        pooled_w = chains_w.reshape(-1, chains_w.shape[-1])
+        pooled_p = pooled_p * pooled_mask[:, None].astype(dt)
+        pooled_w = pooled_w * pooled_mask[:, None].astype(dt)
+        ess_c = jax.vmap(masked_effective_sample_size, in_axes=(0, None))
+        phi_accept = state.phi_accept / n_upd.astype(state.phi_accept.dtype)
+        if phi_accept.ndim == 2:  # (n_chains, q) -> chain average
+            phi_accept = jnp.mean(phi_accept, axis=0)
+        return SubsetResult(
+            param_grid=masked_quantile_grid(
+                pooled_p, pooled_mask, cfg.n_quantiles
+            ),
+            w_grid=masked_quantile_grid(
+                pooled_w, pooled_mask, cfg.n_quantiles
+            ),
+            phi_accept_rate=phi_accept,
+            param_samples=pooled_p,
+            w_samples=pooled_w,
+            param_ess=jnp.sum(ess_c(chains_p, row_mask), axis=0),
+            param_rhat=masked_rhat(chains_p, row_mask),
+            w_ess=jnp.sum(ess_c(chains_w, row_mask), axis=0),
+            w_rhat=masked_rhat(chains_w, row_mask),
+        )
+
 
 # Backwards-compatible name: the probit path is the default link.
 SpatialProbitGP = SpatialGPSampler

@@ -67,24 +67,33 @@ class StreamState(NamedTuple):
     half_n: jnp.ndarray      # (K, C, 2) draw counts per half
     half_mean: jnp.ndarray   # (K, C, 2, d) running means
     half_m2: jnp.ndarray     # (K, C, 2, d) sum of squared deviations
-    n_batches: jnp.ndarray   # () number of chunk-batches folded in
-    n_total: jnp.ndarray     # () kept draws folded in, per chain
+    n_batches: jnp.ndarray   # () — or (K,) per-subset — batches folded
+    n_total: jnp.ndarray     # () — or (K,) — kept draws folded, per chain
     bm_mean: jnp.ndarray     # (K, C, d) Welford mean of batch means
     bm_m2: jnp.ndarray       # (K, C, d) Welford M2 of batch means
 
 
 def init_stream(
-    k: int, n_chains: int, d: int, dtype=jnp.float32
+    k: int, n_chains: int, d: int, dtype=jnp.float32,
+    *, per_subset_counts: bool = False,
 ) -> StreamState:
-    """Zeroed accumulators on the default device."""
+    """Zeroed accumulators on the default device.
+
+    ``per_subset_counts=True`` shapes the batch counters (K,) instead
+    of scalar — required by the adaptive executor's MASKED fold-in
+    (:func:`make_stream_update_masked`), where frozen subsets stop
+    contributing batches and a shared scalar counter would corrupt
+    their batch-means ESS. The unmasked scalar layout stays the
+    default byte-identically."""
     c = max(1, int(n_chains))
     z = lambda *s: jnp.zeros(s, dtype)
+    cnt = (k,) if per_subset_counts else ()
     return StreamState(
         half_n=z(k, c, 2),
         half_mean=z(k, c, 2, d),
         half_m2=z(k, c, 2, d),
-        n_batches=z(),
-        n_total=z(),
+        n_batches=z(*cnt),
+        n_total=z(*cnt),
         bm_mean=z(k, c, d),
         bm_m2=z(k, c, d),
     )
@@ -160,6 +169,94 @@ def make_stream_update(n_half: int, n_chains: int):
     return update
 
 
+def make_stream_update_masked(n_half: int, n_chains: int):
+    """Masked fold-in for the ADAPTIVE executor (ISSUE 18):
+    ``update(stream, chunk, offset, mask)`` where ``offset`` is the
+    global kept-index of the chunk's first row — scalar, or (K,) when
+    subsets write at diverging offsets (a straggler reopened by budget
+    reallocation missed chunks while frozen) — and ``mask`` is a (K,)
+    active-subset vector (1.0 live, 0.0 frozen). A frozen subset's
+    accumulator rows hold zeros past its freeze boundary (the
+    compacted dispatch group stopped writing them), so folding them
+    unmasked would drag its frozen-at diagnostics toward garbage —
+    the mask zeroes every contribution (half moments, batch counter,
+    batch means) of frozen rows, leaving their statistics EXACTLY the
+    freeze-boundary values. Requires a stream built with
+    ``init_stream(..., per_subset_counts=True)``; active rows update
+    identically to :func:`make_stream_update` (same Chan combine,
+    same one-batch-per-chunk rule)."""
+
+    def update(
+        stream: StreamState, chunk: jnp.ndarray, offset, mask
+    ) -> StreamState:
+        if stream.n_batches.ndim != 1:
+            raise ValueError(
+                "masked stream updates need per-subset batch "
+                "counters — init_stream(per_subset_counts=True)"
+            )
+        x = chunk if chunk.ndim == 4 else chunk[:, None]  # (K,C,L,d)
+        dt = stream.half_mean.dtype
+        x = x.astype(dt)
+        mk = mask.astype(dt)  # (K,)
+        k, length = x.shape[0], x.shape[2]
+        ofs = jnp.broadcast_to(
+            jnp.asarray(offset, jnp.int32), (k,)
+        )
+        idx = ofs[:, None] + jnp.arange(length, dtype=jnp.int32)
+        half_id = jnp.where(
+            idx < n_half, 0, jnp.where(idx < 2 * n_half, 1, -1)
+        )  # (K, L)
+        one = jnp.asarray(1.0, dt)
+
+        def fold_half(h: int):
+            # (K, L) row weights: in-half AND subset active
+            msk = (half_id == h).astype(dt) * mk[:, None]
+            cnt = jnp.sum(msk, axis=1)                    # (K,)
+            safe = jnp.maximum(cnt, one)[:, None, None]
+            mean_c = jnp.einsum("kl,kcld->kcd", msk, x) / safe
+            dev = x - mean_c[:, :, None, :]
+            m2_c = jnp.einsum("kl,kcld->kcd", msk, dev * dev)
+            n_a = stream.half_n[:, :, h]                  # (K, C)
+            mean_a = stream.half_mean[:, :, h]
+            m2_a = stream.half_m2[:, :, h]
+            n_new = n_a + cnt[:, None]
+            safe_n = jnp.maximum(n_new, one)[..., None]
+            delta = mean_c - mean_a
+            mean_new = mean_a + delta * (
+                cnt[:, None, None] / safe_n
+            )
+            m2_new = (
+                m2_a + m2_c
+                + delta * delta * (
+                    n_a[..., None] * cnt[:, None, None] / safe_n
+                )
+            )
+            return n_new, mean_new, m2_new
+
+        n0, mu0, m20 = fold_half(0)
+        n1, mu1, m21 = fold_half(1)
+        bm = jnp.mean(x, axis=2)                          # (K, C, d)
+        nb = stream.n_batches + mk                        # (K,)
+        delta_b = bm - stream.bm_mean
+        w_b = (mk / jnp.maximum(nb, one))[:, None, None]
+        bm_mean = stream.bm_mean + delta_b * w_b
+        bm_m2 = stream.bm_m2 + delta_b * (bm - bm_mean) * (
+            mk[:, None, None]
+        )
+        return StreamState(
+            half_n=jnp.stack([n0, n1], axis=2),
+            half_mean=jnp.stack([mu0, mu1], axis=2),
+            half_m2=jnp.stack([m20, m21], axis=2),
+            n_batches=nb,
+            n_total=stream.n_total + mk * jnp.asarray(length, dt),
+            bm_mean=bm_mean,
+            bm_m2=bm_m2,
+        )
+
+    del n_chains
+    return update
+
+
 def make_stream_stats(n_chains: int):
     """Build the boundary stats program: ``stats(stream)`` returns
     ``(rhat, ess, rhat_max, ess_min)`` — (K, d) per-parameter values
@@ -205,13 +302,32 @@ def make_stream_stats(n_chains: int):
 
         nb = stream.n_batches
         n_tot = stream.n_total
-        var_bm = stream.bm_m2 / jnp.maximum(nb - 1.0, one)
-        l_bar = n_tot / jnp.maximum(nb, one)
-        tau = l_bar * var_bm / jnp.maximum(var_c, tiny)
-        ess_c = n_tot / jnp.maximum(tau, one / jnp.maximum(n_tot, one))
-        ess_c = jnp.minimum(ess_c, n_tot)
-        ess = jnp.sum(ess_c, axis=1)             # (K, d)
-        ess = jnp.where(nb >= 2.0, ess, nan)
+        if nb.ndim == 1:
+            # per-subset counters (adaptive masked stream): the same
+            # batch-means algebra with the counts broadcast over the
+            # (K, C, d) moment arrays — the scalar branch below stays
+            # byte-identical for the fixed-schedule monitor
+            nb_b = nb[:, None, None]
+            nt_b = n_tot[:, None, None]
+            var_bm = stream.bm_m2 / jnp.maximum(nb_b - 1.0, one)
+            l_bar = nt_b / jnp.maximum(nb_b, one)
+            tau = l_bar * var_bm / jnp.maximum(var_c, tiny)
+            ess_c = nt_b / jnp.maximum(
+                tau, one / jnp.maximum(nt_b, one)
+            )
+            ess_c = jnp.minimum(ess_c, nt_b)
+            ess = jnp.sum(ess_c, axis=1)         # (K, d)
+            ess = jnp.where(nb[:, None] >= 2.0, ess, nan)
+        else:
+            var_bm = stream.bm_m2 / jnp.maximum(nb - 1.0, one)
+            l_bar = n_tot / jnp.maximum(nb, one)
+            tau = l_bar * var_bm / jnp.maximum(var_c, tiny)
+            ess_c = n_tot / jnp.maximum(
+                tau, one / jnp.maximum(n_tot, one)
+            )
+            ess_c = jnp.minimum(ess_c, n_tot)
+            ess = jnp.sum(ess_c, axis=1)         # (K, d)
+            ess = jnp.where(nb >= 2.0, ess, nan)
 
         return rhat, ess, jnp.max(rhat, axis=1), jnp.min(ess, axis=1)
 

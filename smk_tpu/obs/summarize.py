@@ -221,6 +221,12 @@ def summarize(path: str) -> Dict[str, Any]:
     watchdog = [e["attrs"] for e in _events_named(run, "watchdog")]
     programs = [e["attrs"] for e in _events_named(run, "program")]
     live = [e["attrs"] for e in _events_named(run, "live_diagnostics")]
+    compactions = [
+        e["attrs"] for e in _events_named(run, "adaptive_compaction")
+    ]
+    replans = [
+        e["attrs"] for e in _events_named(run, "adaptive_mesh_replan")
+    ]
     ckpt = [e["attrs"] for e in _events_named(run, "ckpt_write")]
     commits = [e["attrs"] for e in _events_named(run, "ckpt_commit")]
     breakdown = chunk_breakdown(run)
@@ -307,6 +313,22 @@ def summarize(path: str) -> Dict[str, Any]:
         "live_diagnostics": {
             "n_boundaries": len(live),
             "final": live[-1] if live else None,
+        },
+        # ISSUE 18: the adaptive scheduler's visible actions — one
+        # "adaptive_compaction" event per dispatch-group re-formation
+        # (freeze / reopen / rung change) and one
+        # "adaptive_mesh_replan" per post-compaction mesh layout
+        # (meshed runs only, with its rung_pad_waste_frac). Empty on
+        # fixed-schedule runs.
+        "adaptive": {
+            "n_compactions": len(compactions),
+            "compactions": compactions,
+            "mesh_replans": replans,
+            "final_rung_pad_waste_frac": (
+                replans[-1].get("rung_pad_waste_frac")
+                if replans
+                else None
+            ),
         },
         # ISSUE 16: the serving-side view — coalesced-batch
         # occupancy, held-time histogram, shed counters

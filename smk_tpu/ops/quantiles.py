@@ -42,6 +42,36 @@ def quantile_grid(samples: jnp.ndarray, n_quantiles: int = 200) -> jnp.ndarray:
     return jnp.quantile(samples, probs, axis=0)
 
 
+def masked_quantile_grid(
+    samples: jnp.ndarray, mask: jnp.ndarray, n_quantiles: int = 200
+) -> jnp.ndarray:
+    """``quantile_grid`` over only the VALID rows of a capacity buffer.
+
+    Adaptive schedules (ISSUE 18) leave frozen subsets' draw buffers
+    partially filled; ``mask`` (n,) flags the rows that hold real
+    draws. Invalid rows are pushed to +inf before the sort so the
+    valid rows form a sorted prefix, then the type-7 fractional index
+    h = p * (count - 1) is gathered and interpolated — with an
+    all-valid mask this matches ``jnp.quantile``'s linear definition
+    exactly. Works under jit/vmap with a traced mask (shapes stay at
+    capacity; only gather indices depend on the count).
+    """
+    dt = samples.dtype
+    mk = mask.astype(bool)
+    cnt_i = jnp.maximum(jnp.sum(mk.astype(jnp.int32)), 1)
+    cnt = cnt_i.astype(dt)
+    x = jnp.where(mk[:, None], samples, jnp.asarray(jnp.inf, dt))
+    s = jnp.sort(x, axis=0)
+    probs = quantile_probs(n_quantiles, dt)
+    h = probs * (cnt - 1.0)
+    lo = jnp.floor(h).astype(jnp.int32)
+    hi = jnp.minimum(lo + 1, cnt_i - 1)  # never read the +inf tail
+    frac = (h - lo.astype(dt))[:, None]
+    lo_v = jnp.take(s, lo, axis=0)
+    hi_v = jnp.take(s, hi, axis=0)
+    return lo_v + frac * (hi_v - lo_v)
+
+
 def interp_quantile_grid(
     grid: jnp.ndarray, out_step: float = 0.001
 ) -> jnp.ndarray:

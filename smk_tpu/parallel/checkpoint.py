@@ -384,7 +384,6 @@ def identity_config_repr(cfg) -> bytes:
         compile_store_dir=None,
         xla_cache_dir=None,
         run_log_dir=None,
-        live_diagnostics=False,
         profile_dir=None,
         profile_chunks=None,
         watchdog=False,
@@ -392,6 +391,11 @@ def identity_config_repr(cfg) -> bytes:
         watchdog_margin=10.0,
         dist_init_timeout_s=120.0,
         dist_init_retries=3,
+        # live_diagnostics is observation-only, but the adaptive
+        # scheduler (ISSUE 18) requires it on — normalize to the value
+        # adaptive_schedule (which IS identity) forces, so the replace
+        # stays a valid config either way
+        live_diagnostics=(cfg.adaptive_schedule != "off"),
         # the commit deadline is pure coordination (ISSUE 13): a
         # checkpoint written under one deadline must resume under
         # another

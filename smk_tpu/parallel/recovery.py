@@ -61,14 +61,18 @@ from smk_tpu.parallel.partition import (
     Partition,
     ragged_mesh_entry_partition,
 )
+from smk_tpu.parallel.schedule import AdaptiveScheduler
 from smk_tpu.utils.checkpoint import (
     BackgroundWriter,
     is_key_leaf,
     load_pytree,
     load_segment,
+    load_sidecar,
     save_pytree,
     save_segment,
+    save_sidecar,
     segment_path,
+    sidecar_path,
 )
 from smk_tpu.utils.tracing import ChunkPipelineStats, monotonic
 
@@ -288,6 +292,43 @@ def _make_refork(n_chains: int, out_sharding=None):
     if out_sharding is not None:
         return jax.jit(refork, out_shardings=out_sharding)
     return jax.jit(refork)
+
+
+def _make_adaptive_writer(n_chains: int, out_sharding=None):
+    """Build the adaptive-regime draw writer (ISSUE 18): scatter a
+    COMPACTED chunk's draws — (kc, n, d) single-chain or (kc, C, n, d)
+    — into the FULL-K capacity accumulators at a shared kept-iteration
+    ``offset``. ``ids`` (kc,) maps each dispatch-group row to its
+    destination subset row; rows that must not land (ladder pads and
+    frozen riders still computing inside the group) carry id == K and
+    drop out-of-bounds (``mode="drop"``), so one program serves every
+    group composition at a given (kc, n). Donation of the accumulator
+    mirrors executor.write_draws / _make_chunk_fn: real only on
+    donation-capable backends, and gated off for meshed executables on
+    the CPU client where a deserialized donating program corrupts its
+    carry."""
+    from smk_tpu.parallel.executor import _backend_supports_donation
+
+    def write(acc, new, ids, offset):
+        n = new.shape[-2]
+        cols = jnp.asarray(offset, jnp.int32) + jnp.arange(
+            n, dtype=jnp.int32
+        )
+        if n_chains == 1:
+            return acc.at[ids[:, None], cols[None, :]].set(
+                new, mode="drop"
+            )
+        ch = jnp.arange(acc.shape[1], dtype=jnp.int32)
+        return acc.at[
+            ids[:, None, None], ch[None, :, None], cols[None, None, :]
+        ].set(new, mode="drop")
+
+    jit_kw = {}
+    if _backend_supports_donation():
+        jit_kw["donate_argnums"] = (0,)
+    if out_sharding is not None:
+        jit_kw["out_shardings"] = out_sharding
+    return jax.jit(write, **jit_kw)
 
 
 def _key_bytes(key) -> bytes:
@@ -1515,6 +1556,20 @@ def _fit_subsets_chunked_impl(
         raise ValueError(f"chunk_iters must be >= 1, got {chunk_iters}")
     k = part.n_subsets
     data = stacked_subset_data(part, coords_test, x_test)
+    # Adaptive compaction (ISSUE 18, parallel/schedule.py) gathers
+    # shrunken dispatch groups from HOST copies of the stacked
+    # per-subset leaves — captured here, BEFORE any mesh placement, so
+    # a compaction event never fetches sharded leaves back from the
+    # devices (the gathered group is device_put fresh each event).
+    adaptive = cfg.adaptive_schedule == "on"
+    data_np = (
+        {
+            f: np.asarray(getattr(data, f))
+            for f in ("coords", "x", "y", "mask")
+        }
+        if adaptive
+        else None
+    )
     # subset_keys (ISSUE 15): the ragged driver pre-splits one key
     # array over the GLOBAL subset count and hands each bucket group
     # its slice — a subset's chain then depends on its global index,
@@ -1592,11 +1647,38 @@ def _fit_subsets_chunked_impl(
     # buffer).
     n_kept = cfg.n_samples - cfg.n_burn_in
 
+    # ---- adaptive schedule arming (ISSUE 18) -----------------------
+    # The scheduler owns EVERY freeze/compact/reallocate decision
+    # (parallel/schedule.py; smklint SMK118 pins the monopoly); the
+    # executor consults it at exactly one committed-boundary site in
+    # boundary_host_work below. Capacity-sized accumulators make the
+    # straggler extra-chunk allowance a static allocation.
+    if adaptive:
+        if chunk_size is not None:
+            raise ValueError(
+                "adaptive_schedule='on' is incompatible with "
+                "chunk_size: the lax.map inner batching bakes a fixed "
+                "K into the chunk program, and active-set compaction "
+                "changes it mid-run — drop chunk_size or run the "
+                "fixed schedule"
+            )
+        sched = AdaptiveScheduler(
+            cfg, k=k, n_kept=n_kept, chunk_iters=chunk_iters,
+            n_devices=(mesh.devices.size if mesh is not None else 1),
+        )
+        n_cap = sched.n_cap
+    else:
+        sched = None
+        n_cap = n_kept
+    # arrays of the last sidecar-saved scheduler snapshot (the "prev"
+    # half of the next two-snapshot sidecar write)
+    sched_saved: list = [None]
+
     def empty_draws():
         lead = (k,) if cfg.n_chains == 1 else (k, cfg.n_chains)
         return (
-            jnp.zeros(lead + (n_kept, d_par), dtype),
-            jnp.zeros(lead + (n_kept, d_w), dtype),
+            jnp.zeros(lead + (n_cap, d_par), dtype),
+            jnp.zeros(lead + (n_cap, d_w), dtype),
         )
 
     def to_capacity(draws_np):
@@ -1608,7 +1690,7 @@ def _fit_subsets_chunked_impl(
         distinct filled length, which would make every resume point a
         recompile_guard hit (ISSUE 8 — resumes on a warm store are
         compile-free, regression-tested in test_compile_store.py)."""
-        short = n_kept - draws_np.shape[-2]
+        short = n_cap - draws_np.shape[-2]
         if short != 0:
             pad = [(0, 0)] * (draws_np.ndim - 2) + [(0, short), (0, 0)]
             draws_np = np.pad(draws_np, pad)
@@ -1639,6 +1721,14 @@ def _fit_subsets_chunked_impl(
             and dist_ckpt.is_distributed_manifest(checkpoint_path)
         )
     )
+    if adaptive and use_v8:
+        raise NotImplementedError(
+            "adaptive_schedule='on' is not supported with the v8 "
+            "distributed checkpoint layout (multi-process mesh): the "
+            "scheduler sidecar and the full-K state merge are "
+            "single-host operations — run the fixed schedule, or "
+            "checkpoint adaptively on a single-process mesh"
+        )
     if use_v8:
         # cross-host identity (ISSUE 13 satellite): per-process
         # digests of the ADDRESSABLE shards, all-gathered and folded
@@ -1953,6 +2043,222 @@ def _fit_subsets_chunked_impl(
             param_draws = put(param_draws)
             w_draws = put(w_draws)
 
+    # ---- adaptive regime derivation (ISSUE 18) ---------------------
+    # The adaptive executor dispatches a COMPACTED group of ``kc``
+    # rows (a sqrt-2 bucket-ladder rung covering the active set,
+    # device-multiple under a mesh) while the draw accumulators and
+    # the checkpoint stay FULL-K: the scatter writer drops retired
+    # rows on the way in, and a host-side full-K state mirror
+    # (``state_full``, key leaves lowered to raw key data) keeps every
+    # subset's stop-time carry for the checkpoint manifest and the
+    # masked finalize. All mutable group state lives in the closures
+    # below; the fixed schedule never touches any of it.
+    data_c = data
+    kc = k
+    members: list = list(range(k))
+    state_full = None
+    write_ids_dev = None
+    write_mask_dev = None
+    write_members: tuple = ()
+    write_mask_np = np.ones(k, bool)
+    adaptive_done = False
+
+    def _state_host(tree):
+        """Fetch a carried-state tree to host numpy, PRNG key leaves
+        lowered to raw key data (the HostSnapshot convention)."""
+        def fetch_leaf(a):
+            if is_key_leaf(a):
+                return np.asarray(jax.random.key_data(a))
+            return np.asarray(a)
+
+        return jax.tree_util.tree_map(fetch_leaf, tree)
+
+    def _full_state_typed():
+        """The full-K host mirror with key leaves re-wrapped — the
+        tree the checkpoint manifest and the finalize consume."""
+        def retype_leaf(a, s):
+            if jax.dtypes.issubdtype(s.dtype, jax.dtypes.prng_key):
+                return jax.random.wrap_key_data(jnp.asarray(a))
+            return a
+
+        return jax.tree_util.tree_map(
+            retype_leaf, state_full, init_like
+        )
+
+    def _merge_state_full():
+        """Fold the live compacted rows back into the full-K mirror
+        (named member rows only — ladder pads are clones)."""
+        nonlocal state_full
+        if not members:
+            return
+        rows = np.asarray(members, np.int64)
+        nm = len(members)
+        with explicit_d2h("adaptive_state_merge"):
+            host_c = _state_host(state)
+
+        def merge_leaf(full, comp):
+            full[rows] = comp[:nm]
+            return full
+
+        jax.tree_util.tree_map(merge_leaf, state_full, host_c)
+
+    def _set_write_group():
+        """Refresh the scatter id vector and the streaming mask for
+        the CURRENT group composition: group row -> destination
+        subset row, K (out-of-bounds drop) for pads and frozen
+        riders."""
+        nonlocal write_ids_dev, write_mask_dev, write_members
+        nonlocal write_mask_np
+        ids = np.full(kc, k, np.int32)
+        wm = np.zeros(k, bool)
+        frozen = sched.frozen
+        for r, j in enumerate(members):
+            if not frozen[j]:
+                ids[r] = j
+                wm[j] = True
+        write_members = tuple(
+            int(j) for j in members if not frozen[j]
+        )
+        write_mask_np = wm
+        if repl is not None:
+            write_ids_dev = jax.device_put(ids, repl)
+            write_mask_dev = jax.device_put(wm, repl)
+        else:
+            write_ids_dev = jax.device_put(ids)
+            write_mask_dev = jax.device_put(wm)
+
+    def _apply_group(new_members):
+        """(Re)build the dispatch group: carried-state and data rows
+        for ``new_members``, padded to the rung with clones of the
+        first member (their draws drop — id K). Reopened subsets
+        resume from their stop-time rows of ``state_full``, so their
+        chain (PRNG sequence included) continues bit-identically."""
+        nonlocal state, data_c, kc, members
+        members = [int(j) for j in new_members]
+        kc = sched.rung(len(members)) if members else 0
+        if not members:
+            return
+        group = members + [members[0]] * (kc - len(members))
+        rows = np.asarray(group, np.int64)
+        st = jax.tree_util.tree_map(lambda a: a[rows], state_full)
+        st = jax.tree_util.tree_map(
+            lambda a, s: jax.random.wrap_key_data(jnp.asarray(a))
+            if jax.dtypes.issubdtype(s.dtype, jax.dtypes.prng_key)
+            else a,
+            st, init_like,
+        )
+        dn = {f: data_np[f][rows] for f in ("coords", "x", "y", "mask")}
+        if put is not None:
+            state = put(st)
+            data_c = data._replace(
+                coords=put(dn["coords"]), x=put(dn["x"]),
+                y=put(dn["y"]), mask=put(dn["mask"]),
+            )
+        else:
+            state = jax.device_put(st)
+            data_c = data._replace(
+                coords=jax.device_put(dn["coords"]),
+                x=jax.device_put(dn["x"]),
+                y=jax.device_put(dn["y"]),
+                mask=jax.device_put(dn["mask"]),
+            )
+        _set_write_group()
+        if mesh is not None:
+            # honest post-compaction layout telemetry: replan the
+            # shrunken group onto the (unchanged) device mesh — kc is
+            # a device multiple by construction, so the plan is one
+            # full-mesh entry; the rung pad waste is reported
+            # separately from the ragged m-axis pad waste
+            mplan = plan_ragged_mesh([m], [kc], mesh.devices.size)
+            if run_log is not None:
+                run_log.event(
+                    "adaptive_mesh_replan", kc=kc,
+                    n_active=len(members),
+                    entries=len(mplan.entries),
+                    rung_pad_waste_frac=(
+                        (kc - len(members)) / kc if kc else 0.0
+                    ),
+                )
+
+    if adaptive:
+        if holes:
+            raise ValueError(
+                "adaptive_schedule='on' cannot resume a checkpoint "
+                "with corrupt draw segments (lenient holes): the "
+                "scheduler's row-validity map cannot attribute "
+                "refilled rows — delete the checkpoint, or resume "
+                "with adaptive_schedule='off'"
+            )
+        have_sidecar = checkpoint_path is not None and os.path.exists(
+            sidecar_path(checkpoint_path, "sched")
+        )
+        if have_sidecar:
+            blobs = load_sidecar(checkpoint_path, "sched")
+            snaps = [
+                {
+                    n_[len(pfx):]: v
+                    for n_, v in blobs.items()
+                    if n_.startswith(pfx)
+                }
+                for pfx in ("cur_", "prev_")
+            ]
+            # Adopt the snapshot written at exactly the manifest's
+            # boundary (the sidecar holds the latest boundary AND the
+            # one before it, so a crash between sidecar and manifest —
+            # manifest one boundary behind — still pairs exactly).
+            adopted = None
+            for sn in snaps:
+                if sn and int(np.asarray(sn["ledger"])[4]) == it:
+                    adopted = sn
+                    break
+            if adopted is not None:
+                sched.restore_arrays(adopted)
+                sched_saved[0] = sched.to_arrays()
+            elif max(0, it - cfg.n_burn_in) > 0:
+                raise ValueError(
+                    f"checkpoint {checkpoint_path} does not pair with "
+                    "its scheduler sidecar (manifest iteration "
+                    f"{it} matches neither sidecar snapshot) — the "
+                    "sidecar is written before every manifest and "
+                    "keeps one boundary of history, so this pairing "
+                    "cannot come from one run; delete both and restart"
+                )
+            # else: sidecar from a crashed future samp boundary while
+            # the manifest is still in burn-in — replay refolds the
+            # boundary deterministically from a fresh scheduler
+        elif max(0, it - cfg.n_burn_in) > 0:
+            raise ValueError(
+                f"checkpoint {checkpoint_path} has kept draws but no "
+                "scheduler sidecar "
+                f"({sidecar_path(checkpoint_path, 'sched')}) — it was "
+                "written by a fixed-schedule run (adaptive schedules "
+                "change run identity; cross-policy resume is "
+                "rejected) or the sidecar was deleted"
+            )
+        with explicit_d2h("adaptive_state_merge"):
+            # np.array (not asarray): the mirror is mutated in place by
+            # _merge_state_full, and asarray of a jax array is read-only
+            state_full = jax.tree_util.tree_map(
+                np.array, _state_host(state)
+            )
+        # Frozen subsets with no departure stamp are still RIDING in
+        # the dispatch group (the rung has not shrunk past them) —
+        # resume must reconstruct the exact group the uninterrupted
+        # run had at this boundary, riders included, so the surviving
+        # chains replay bit-identically.
+        group_now = sorted(
+            set(sched.active_ids)
+            | {
+                int(j)
+                for j in np.flatnonzero(sched.frozen)
+                if sched.it_stopped[j] < 0
+            }
+        )
+        if len(group_now) == k:
+            _set_write_group()
+        else:
+            _apply_group(group_now)
+
     # L2 program store (ISSUE 8, topology-aware since ISSUE 12):
     # consulted BEFORE tracing — a store hit deserializes the
     # executable and the chunk program never compiles in this
@@ -1982,17 +2288,55 @@ def _fit_subsets_chunked_impl(
     t_test = coords_test.shape[0]
     d_coord = coords_test.shape[1]
 
+    _lead_cache: dict = {}
+
+    def _lead_like(kk):
+        """State avals with the leading axis rebucketed to ``kk`` —
+        lowering arguments for the ladder-K' rung programs (adaptive
+        compaction). At kk == k this IS init_like_lowered, so the
+        fixed-schedule programs lower identically."""
+        if kk == k:
+            return init_like_lowered
+        if kk not in _lead_cache:
+            def one(s):
+                sh = (kk,) + tuple(s.shape[1:])
+                if getattr(s, "sharding", None) is not None:
+                    return jax.ShapeDtypeStruct(
+                        sh, s.dtype, sharding=s.sharding
+                    )
+                return jax.ShapeDtypeStruct(sh, s.dtype)
+
+            _lead_cache[kk] = jax.tree_util.tree_map(
+                one, init_like_lowered
+            )
+        return _lead_cache[kk]
+
     def chunk_fn(kind: str, n: int):
+        # under the adaptive regime the dispatch group is the current
+        # rung kc; K is in every bucket key (compile/programs), so
+        # ladder-K' programs resolve through the same L1/L2 store and
+        # the kc == k entry point is byte-identical to the fixed path
+        kk = kc if adaptive else k
         return _cached_program(
             model,
             _chunk_key(
-                model, kind, n, k, chunk_size, m, q, p, t_test,
+                model, kind, n, kk, chunk_size, m, q, p, t_test,
                 d_coord, mesh=mesh,
             ),
             lambda: _make_chunk_fn(
-                model, kind, n, k, chunk_size, out_sharding=shard
+                model, kind, n, kk, chunk_size, out_sharding=shard
             ),
-            store=store, lower_args=chunk_lower, stats=pstats,
+            store=store,
+            lower_args=(
+                (
+                    (data_c, _lead_like(kk), jax.device_put(0))
+                    if adaptive
+                    else chunk_lower
+                )
+                if store is not None
+                else None
+            ),
+            stats=pstats,
         )
 
     n_burn = cfg.n_burn_in
@@ -2003,8 +2347,22 @@ def _fit_subsets_chunked_impl(
     # (resolving it here, not per boundary, keeps the hot loop to a
     # dict hit; with the store off this IS the module-level
     # _chunk_stats jit, byte-identically)
-    stats_fn = (
-        _cached_program(
+    if want_stats and adaptive:
+        # rung-aware: the guard vector covers the CURRENT dispatch
+        # group (kc rows); resolution stays an L1 dict hit per
+        # boundary, and the kc == k key is the fixed path's own
+        def stats_fn(st):
+            return _cached_program(
+                model, _stats_key(model, kc, m, q, p, mesh=mesh),
+                lambda: _chunk_stats,
+                store=store,
+                lower_args=(
+                    (_lead_like(kc),) if store is not None else None
+                ),
+                stats=pstats,
+            )(st)
+    elif want_stats:
+        stats_fn = _cached_program(
             model, _stats_key(model, k, m, q, p, mesh=mesh),
             lambda: _chunk_stats,
             store=store,
@@ -2013,9 +2371,8 @@ def _fit_subsets_chunked_impl(
             ),
             stats=pstats,
         )
-        if want_stats
-        else None
-    )
+    else:
+        stats_fn = None
 
     # ---- observability arming (ISSUE 10, smk_tpu/obs/) ------------
     # Streaming convergence monitor: O(K * d_par) Welford/batch-means
@@ -2036,21 +2393,42 @@ def _fit_subsets_chunked_impl(
             init_stream,
             make_stream_stats,
             make_stream_update,
+            make_stream_update_masked,
         )
 
         n_half_stream = n_kept // 2
 
-        def stream_update(length: int):
-            return _cached_program(
-                model,
-                compile_programs.aux_bucket_key(
-                    model, "stream", length, k, d_par, mesh=mesh
-                ),
-                lambda: jax.jit(
-                    make_stream_update(n_half_stream, cfg.n_chains)
-                ),
-                stats=pstats,
-            )
+        if adaptive:
+            # masked fold-in (ISSUE 18): frozen subsets stop
+            # contributing batches — their statistics stay pinned at
+            # the freeze-boundary values bit-exactly. The halves keep
+            # the fixed schedule's [0, n_kept) geometry; extra-chunk
+            # rows past 2*n_half fold into the batch-means ESS only.
+            def stream_update(length: int):
+                return _cached_program(
+                    model,
+                    compile_programs.aux_bucket_key(
+                        model, "streamm", length, k, d_par, mesh=mesh
+                    ),
+                    lambda: jax.jit(
+                        make_stream_update_masked(
+                            n_half_stream, cfg.n_chains
+                        )
+                    ),
+                    stats=pstats,
+                )
+        else:
+            def stream_update(length: int):
+                return _cached_program(
+                    model,
+                    compile_programs.aux_bucket_key(
+                        model, "stream", length, k, d_par, mesh=mesh
+                    ),
+                    lambda: jax.jit(
+                        make_stream_update(n_half_stream, cfg.n_chains)
+                    ),
+                    stats=pstats,
+                )
 
         stream_stats_fn = _cached_program(
             model,
@@ -2061,9 +2439,43 @@ def _fit_subsets_chunked_impl(
             stats=pstats,
         )
         stream_nbytes = fetch_nbytes(k)
-        stream = init_stream(k, cfg.n_chains, d_par, dtype)
+        stream = init_stream(
+            k, cfg.n_chains, d_par, dtype,
+            per_subset_counts=adaptive,
+        )
         filled_now = max(0, it - cfg.n_burn_in)
-        if filled_now > 0 and not holes:
+        if adaptive and filled_now > 0:
+            # masked resume backfill: replay the filled region in the
+            # ORIGINAL chunk layout (base sampling lengths, then the
+            # fixed extra-chunk length), each chunk masked by the
+            # scheduler's row-validity map — a subset wrote a chunk
+            # wholly or not at all, so one column of rows_valid is
+            # exactly the original participation mask
+            ofs = 0
+            while ofs < filled_now:
+                if ofs < n_kept:
+                    ln = min(
+                        chunk_iters, n_kept - ofs, filled_now - ofs
+                    )
+                else:
+                    ln = min(sched.l_extra, filled_now - ofs)
+                o_dev = _slice_offset(ofs)
+                mrow = np.ascontiguousarray(
+                    sched.rows_valid[:, ofs]
+                )
+                m_dev = (
+                    jax.device_put(mrow, repl)
+                    if repl is not None
+                    else jax.device_put(mrow)
+                )
+                stream = stream_update(ln)(
+                    stream,
+                    _slice_draws(param_draws, o_dev, ln),
+                    o_dev,
+                    m_dev,
+                )
+                ofs += ln
+        elif filled_now > 0 and not holes:
             # resume backfill: replay the already-filled kept region
             # through the SAME per-length update programs the ongoing
             # run uses (the historical chunk layout is recomputed from
@@ -2201,44 +2613,79 @@ def _fit_subsets_chunked_impl(
             it_plan += n_f
             ofs += n_f
             left -= n_f
+    if adaptive:
+        # granted-but-uncommitted extra chunks survive a kill in the
+        # scheduler sidecar (written BEFORE the manifest); re-append
+        # them so the resumed plan is the one the grant decided
+        for s_g, ln_g in sched.pending_extras(it):
+            plan.append(("extra", s_g, ln_g, s_g - n_burn))
+        if not members:
+            # every subset already frozen at resume: nothing left to
+            # dispatch — fall straight through to the masked finalize
+            plan = []
     truncated = False
-    if stop_after_chunks is not None and stop_after_chunks < len(plan):
+    if (
+        not adaptive
+        and stop_after_chunks is not None
+        and stop_after_chunks < len(plan)
+    ):
         plan = plan[:stop_after_chunks]
         truncated = True
+    # (adaptive runs enforce stop_after_chunks dynamically in the
+    # loop: the plan GROWS at grant boundaries, so a static prefix
+    # truncation could never kill inside the reallocated tail)
 
     stats_bytes = k + 4  # (K,) bool + one f32 scalar per boundary
     t_loop0 = monotonic()
-    refork = (
-        _cached_program(
-            model, _refork_key(model, k, m, q, p, mesh=mesh),
+
+    def refork_fn():
+        # the quarantine relaunch must reuse the stored program:
+        # a disk-warm model's FIRST fault would otherwise compile
+        # the refork on the retry critical path
+        # (tests/test_compile_store.py pins zero compiles there).
+        # Under a mesh the retry masks lower REPLICATED — the
+        # same shardings apply_rewind feeds at runtime. Under the
+        # adaptive regime the mask covers the CURRENT rung (kc rows).
+        kk = kc if adaptive else k
+        return _cached_program(
+            model, _refork_key(model, kk, m, q, p, mesh=mesh),
             lambda: _make_refork(cfg.n_chains, out_sharding=shard),
             store=store,
-            # the quarantine relaunch must reuse the stored program:
-            # a disk-warm model's FIRST fault would otherwise compile
-            # the refork on the retry critical path
-            # (tests/test_compile_store.py pins zero compiles there).
-            # Under a mesh the retry masks lower REPLICATED — the
-            # same shardings apply_rewind feeds at runtime.
             lower_args=(
                 (
-                    init_like_lowered,
+                    _lead_like(kk),
                     jax.ShapeDtypeStruct(
-                        (k,), np.bool_, sharding=repl
+                        (kk,), np.bool_, sharding=repl
                     ) if repl is not None
-                    else jax.ShapeDtypeStruct((k,), np.bool_),
+                    else jax.ShapeDtypeStruct((kk,), np.bool_),
                     jax.ShapeDtypeStruct(
-                        (k,), np.int32, sharding=repl
+                        (kk,), np.int32, sharding=repl
                     ) if repl is not None
-                    else jax.ShapeDtypeStruct((k,), np.int32),
+                    else jax.ShapeDtypeStruct((kk,), np.int32),
                 )
                 if store is not None
                 else None
             ),
             stats=pstats,
         )
-        if policy_q
-        else None
-    )
+
+    refork = refork_fn() if policy_q else None
+
+    def adraws_fn(n: int):
+        # the adaptive scatter writer, per (chunk length, rung) — an
+        # L1-only program like the stream fold-ins (its tiny scatter
+        # is not worth an on-disk executable; the in-process cache
+        # keeps warm adaptive reruns compile-free)
+        return _cached_program(
+            model,
+            compile_programs.aux_bucket_key(
+                model, "adraws", n, kc, k, cfg.n_chains, mesh=mesh
+            ),
+            lambda: _make_adaptive_writer(
+                cfg.n_chains, out_sharding=shard
+            ),
+            stats=pstats,
+        )
 
     # Chunk watchdog (ISSUE 11, parallel/domains.ChunkWatchdog): each
     # guarded section runs on a watchdog worker thread while this
@@ -2281,14 +2728,16 @@ def _fit_subsets_chunked_impl(
         # EXPLICIT transfer under transfer_guard_strict; both produce
         # the same weak-int32 aval, so the chunk program is unchanged
         start_dev = jax.device_put(start)
+        dref = data_c if adaptive else data
         if kind == "burn":
-            state = chunk_fn("burn", n)(data, state, start_dev)
+            state = chunk_fn("burn", n)(dref, state, start_dev)
         else:
             # "fill" chunks run the SAME compiled sampling program —
             # only their write offset differs (a traced scalar, so no
-            # recompile per hole)
+            # recompile per hole). "extra" chunks (adaptive budget
+            # grants) likewise: same program, offsets past n_kept.
             state, (pd, wd) = chunk_fn("samp", n)(
-                data, state, start_dev
+                dref, state, start_dev
             )
             # draws land at [w_ofs, w_ofs + n) on the iteration axis
             # of the PREALLOCATED accumulators — axis 1 for a single
@@ -2297,8 +2746,18 @@ def _fit_subsets_chunked_impl(
             # update output on donation-capable backends
             # (executor.write_draws; shape-matching is what makes the
             # donation actually alias, unlike a growing concat).
-            param_draws = write_draws(param_draws, pd, w_ofs)
-            w_draws = write_draws(w_draws, wd, w_ofs)
+            if adaptive:
+                # compacted (kc-row) chunk outputs scatter into the
+                # full-K accumulators; pads and frozen riders drop
+                o_dev = _slice_offset(w_ofs)
+                wr = adraws_fn(n)
+                param_draws = wr(
+                    param_draws, pd, write_ids_dev, o_dev
+                )
+                w_draws = wr(w_draws, wd, write_ids_dev, o_dev)
+            else:
+                param_draws = write_draws(param_draws, pd, w_ofs)
+                w_draws = write_draws(w_draws, wd, w_ofs)
         if kind != "fill":
             it = start + n
 
@@ -2464,6 +2923,48 @@ def _fit_subsets_chunked_impl(
             mask[retry_subsets] = True
             raise _QuarantineRewind(mask)
 
+    def apply_decision(dec, b):
+        """Apply one committed boundary's scheduler decision: append
+        the granted extra chunk (if any), re-form the dispatch group
+        when the rung or the membership changes (compaction, or a
+        budget-frozen straggler reopened by a grant), and flag run
+        completion so the loop drops any remaining planned chunks."""
+        nonlocal adaptive_done
+        if dec.grant is not None:
+            s_g, ln_g = dec.grant
+            plan.append(("extra", s_g, ln_g, s_g - n_burn))
+        new_active = [int(j) for j in dec.active]
+        new_kc = sched.rung(len(new_active)) if new_active else 0
+        mem = set(members)
+        need_regroup = new_kc != kc or any(
+            j not in mem for j in new_active
+        )
+        if need_regroup:
+            gone = [j for j in members if j not in set(new_active)]
+            sched.mark_stopped(gone, b["it"])
+            _apply_group(new_active)
+            if run_log is not None:
+                run_log.event(
+                    "adaptive_compaction", iteration=b["it"],
+                    kc=kc, n_active=len(new_active),
+                    newly_frozen=list(dec.newly_frozen),
+                    newly_budget_frozen=list(
+                        dec.newly_budget_frozen
+                    ),
+                    newly_reopened=list(dec.newly_reopened),
+                )
+        elif (
+            dec.newly_frozen
+            or dec.newly_budget_frozen
+            or dec.newly_reopened
+        ):
+            # membership unchanged (the rung still covers the active
+            # set): newly frozen subsets ride as non-writing rows
+            # until the rung shrinks — refresh the write set only
+            _set_write_group()
+        if dec.all_done:
+            adaptive_done = True
+
     def boundary_host_work(b, stall):
         """Guard + report + checkpoint for one completed chunk.
 
@@ -2493,6 +2994,21 @@ def _fit_subsets_chunked_impl(
                     b["stats"][1],
                     timeout_s=cfg.ckpt_commit_timeout_s,
                 ))
+            if adaptive:
+                # the guard vector covers the kc-row dispatch group;
+                # expand to subset index space. Frozen riders and
+                # ladder pads map to True: a frozen subset is never a
+                # rewind candidate (its chunk-start hold is released
+                # — the quarantine/adaptive interplay contract,
+                # tests/test_fault_isolation.py), and pad rows are
+                # clones whose health is their source row's.
+                fin_full = np.ones(k, bool)
+                fin_c = np.asarray(finite, bool)
+                wset = set(b["written"])
+                for r, j in enumerate(b["members"]):
+                    if j in wset:
+                        fin_full[j] = bool(fin_c[r])
+                finite = fin_full
             if policy_q:
                 # quarantine replaces the abort guard wholesale: a
                 # rewind skips this boundary's report AND save (the
@@ -2545,11 +3061,49 @@ def _fit_subsets_chunked_impl(
                     "live_diagnostics", iteration=b["it"],
                     rhat_max=live_rh, ess_min=live_es,
                 )
-        if b["stats"] is not None and b["phase"] != "fill":
-            # refill chunks run PAST n_samples at hole offsets —
-            # feeding them to the user progress callback would
-            # break its documented contract (phases burn/sample,
-            # iteration <= n_samples, monotone progress)
+            if sched is not None and b["kind"] in ("samp", "extra"):
+                # THE adaptive consult site (ISSUE 18; smklint SMK118
+                # pins this as the executor's ONE read of the
+                # streaming verdict for scheduling): fold the
+                # committed boundary in, then apply the decision —
+                # freeze/compact/reallocate — before the manifest
+                # lands, with the scheduler sidecar written FIRST so
+                # a crash between the two replays idempotently.
+                decision = sched.observe(
+                    kind=b["kind"], it=b["it"],
+                    span=(b["a"], b["b"]),
+                    written=b["written"], kc_dispatched=b["kc"],
+                    rhat_max=live_rh, ess_min=live_es,
+                    plan_exhausted=(b["index"] == len(plan) - 1),
+                )
+                apply_decision(decision, b)
+                if ck is not None and b["save"]:
+                    # Two-snapshot sidecar, written post-decision (so
+                    # departures decided at this boundary are stamped)
+                    # and BEFORE the manifest: "cur" is this
+                    # boundary's state, "prev" the last saved one. A
+                    # crash between sidecar and manifest leaves the
+                    # manifest one boundary behind — resume adopts
+                    # whichever snapshot matches the manifest
+                    # iteration exactly.
+                    cur = sched.to_arrays()
+                    prev = sched_saved[0] if sched_saved[0] else cur
+                    save_sidecar(
+                        checkpoint_path, "sched",
+                        {
+                            **{f"prev_{n_}": v for n_, v in prev.items()},
+                            **{f"cur_{n_}": v for n_, v in cur.items()},
+                        },
+                    )
+                    sched_saved[0] = cur
+        if b["stats"] is not None and b["phase"] not in (
+            "fill", "extra"
+        ):
+            # refill chunks run PAST n_samples at hole offsets, and
+            # adaptive extra chunks likewise — feeding either to the
+            # user progress callback would break its documented
+            # contract (phases burn/sample, iteration <= n_samples,
+            # monotone progress)
             report(
                 b["phase"], b["it"], b["window_start"], accept,
                 live=live_vals,
@@ -2595,7 +3149,9 @@ def _fit_subsets_chunked_impl(
         rewrite_full publishes them in one merged segment."""
         nonlocal state, stream
         it_end = start + n
-        phase = {"burn": "burn", "fill": "fill"}.get(kind, "sample")
+        phase = {
+            "burn": "burn", "fill": "fill", "extra": "extra"
+        }.get(kind, "sample")
         stats = stats_fn(state) if want_stats else None
         if stats is not None and mode == "overlap":
             for leaf in stats:
@@ -2612,11 +3168,22 @@ def _fit_subsets_chunked_impl(
         # skipped (their rows are published by the terminal rewrite).
         stream_prev = stream
         live = None
-        if stream is not None and kind == "samp":
+        if stream is not None and kind in ("samp", "extra"):
             o_dev = _slice_offset(start - n_burn)
-            stream = stream_update(n)(
-                stream, _slice_draws(param_draws, o_dev, n), o_dev
-            )
+            if adaptive:
+                # masked fold-in: only the rows the scatter writer
+                # actually landed this chunk (the same mask) — frozen
+                # subsets' statistics stay pinned bit-exactly
+                stream = stream_update(n)(
+                    stream,
+                    _slice_draws(param_draws, o_dev, n),
+                    o_dev,
+                    write_mask_dev,
+                )
+            else:
+                stream = stream_update(n)(
+                    stream, _slice_draws(param_draws, o_dev, n), o_dev
+                )
             s_out = stream_stats_fn(stream)
             live = (s_out[2], s_out[3])
             if mode == "overlap":
@@ -2637,6 +3204,13 @@ def _fit_subsets_chunked_impl(
                 phi_accept=jnp.zeros_like(state.phi_accept)
             )
         filled = max(0, it_end - n_burn)
+        if adaptive:
+            # keep the full-K host mirror current: the manifest and
+            # the masked finalize need every subset's stop-time carry,
+            # and a quarantine rewind simply re-merges the same rows
+            # after the replay (self-healing — the faulted boundary's
+            # manifest is never written)
+            _merge_state_full()
         state_src = seg_src = None
         d2h = stats_bytes if stats is not None else 0
         if live is not None:
@@ -2646,9 +3220,11 @@ def _fit_subsets_chunked_impl(
             # HostSnapshot/full tree; v8: LocalShardSnapshot /
             # addressable rows only) so this record site is
             # checkpoint-format-agnostic
-            state_src, nb = ck.snapshot(state)
+            state_src, nb = ck.snapshot(
+                _full_state_typed() if adaptive else state
+            )
             d2h += nb
-            if kind == "samp":
+            if kind in ("samp", "extra"):
                 a, b_ = start - n_burn, filled
                 ofs = _slice_offset(a)
                 sl_p = _slice_draws(param_draws, ofs, b_ - a)
@@ -2664,6 +3240,13 @@ def _fit_subsets_chunked_impl(
             "save": kind != "fill",
             "dispatch_s": dispatch_s, "d2h_bytes": d2h,
             "live": live, "stream_prev": stream_prev,
+            # adaptive consult/rewind context, captured at dispatch
+            "kind": kind, "kc": kc, "members": tuple(members),
+            "group": tuple(
+                members + [members[0]] * (kc - len(members))
+            ) if members else (),
+            "written": write_members,
+            "a": start - n_burn, "b": filled,
         }
 
     def apply_rewind(b, rw):
@@ -2681,8 +3264,21 @@ def _fit_subsets_chunked_impl(
             # successor's) — jax arrays are immutable, so the
             # boundary's pre-update reference IS the rewound state
             stream = b.get("stream_prev", stream)
-        mask_dev = jnp.asarray(rw.retry_mask)
-        att_dev = jnp.asarray(attempts, jnp.int32)
+        if adaptive:
+            # the retry mask is in subset index space; the held state
+            # is the chunk's COMPACTED group — gather mask/attempts to
+            # group rows (a rewind always targets the chunk whose
+            # composition is still current: the quarantine raise
+            # precedes the scheduler consult). A frozen subset never
+            # appears in the mask (its guard rows expand to True), so
+            # its ladder is untouched while frozen and intact when a
+            # reallocation grant reopens it.
+            grp = np.asarray(b["group"], np.int64)
+            mask_dev = jnp.asarray(rw.retry_mask[grp])
+            att_dev = jnp.asarray(attempts[grp], jnp.int32)
+        else:
+            mask_dev = jnp.asarray(rw.retry_mask)
+            att_dev = jnp.asarray(attempts, jnp.int32)
         if repl is not None:
             # match the stored/lowered refork executable's replicated
             # mask avals (a committed mismatched array would be
@@ -2692,7 +3288,9 @@ def _fit_subsets_chunked_impl(
         # the refork's out_shardings pin means the relaunched carry
         # presents the exact leading-K shardings the (possibly
         # stored) chunk executable was compiled against
-        state = refork(b["held"], mask_dev, att_dev)
+        state = (refork_fn() if adaptive else refork)(
+            b["held"], mask_dev, att_dev
+        )
         if b["phase"] != "fill":
             it = b["start"]
 
@@ -2748,8 +3346,9 @@ def _fit_subsets_chunked_impl(
                     rec["start"] = start
                     return rec
 
-                novel = (kind, n) not in seen_programs
-                seen_programs.add((kind, n))
+                pk = (kind, n, kc) if adaptive else (kind, n)
+                novel = pk not in seen_programs
+                seen_programs.add(pk)
                 b = _guarded(_chunk_work, idx, start + n, novel=novel)
                 idx += 1
                 if mode == "overlap":
@@ -2777,6 +3376,23 @@ def _fit_subsets_chunked_impl(
                 apply_rewind(todo, rw)
                 idx = todo["index"]
                 pending = None
+            if adaptive:
+                if adaptive_done:
+                    # every subset frozen with nothing granted: the
+                    # remaining planned chunks are the saving — drop
+                    # them (adaptive runs are sync, so nothing is in
+                    # flight)
+                    idx = len(plan)
+                    pending = None
+                if (
+                    stop_after_chunks is not None
+                    and idx >= stop_after_chunks
+                ):
+                    # dynamic kill hook: the adaptive plan grows at
+                    # grant boundaries, so the cutoff is enforced
+                    # here rather than by static prefix truncation
+                    truncated = True
+                    break
         if ck is not None and mode == "overlap":
             t0 = monotonic()
             ck.ensure_synced(state, it, max(0, it - n_burn))
@@ -2817,6 +3433,11 @@ def _fit_subsets_chunked_impl(
             writer.close()
         if pstats is not None:
             pstats.total_wall_s = monotonic() - t_loop0
+            if sched is not None:
+                # the adaptive telemetry payload (frozen_at /
+                # chunks_saved_frac / slot ledger) — recorded on every
+                # exit path, including a dynamic stop_after_chunks kill
+                pstats.adaptive = sched.summary()
 
     if truncated:
         return None
@@ -2827,6 +3448,52 @@ def _fit_subsets_chunked_impl(
         else contextlib.nullcontext()
     )
     with fin_span:
+        if adaptive:
+            # Subsets still active at plan exhaustion ran the full
+            # schedule: stamp their stop iteration and pull their final
+            # state rows into the host mirror before finalizing.
+            if members:
+                sched.mark_stopped(members, it)
+                _merge_state_full()
+            stops = np.asarray(sched.it_stopped, np.int64)
+            stops = np.where(stops < 0, it, stops).astype(np.int32)
+            rows_np = np.ascontiguousarray(sched.rows_valid)
+            state_f = _full_state_typed()
+            if put is not None:
+                state_f = put(state_f)
+                row_mask = put(jnp.asarray(rows_np))
+                it_ends = put(jnp.asarray(stops))
+            else:
+                state_f = jax.device_put(state_f)
+                row_mask = jax.device_put(jnp.asarray(rows_np))
+                it_ends = jax.device_put(jnp.asarray(stops))
+            fin = _cached_program(
+                model,
+                compile_programs.aux_bucket_key(
+                    model, "finadapt", k, m, q, n_cap, d_par, d_w,
+                    mesh=mesh,
+                ),
+                lambda: (
+                    jax.jit(
+                        jax.vmap(model.finalize_masked),
+                        out_shardings=shard,
+                    )
+                    if shard is not None
+                    else jax.jit(jax.vmap(model.finalize_masked))
+                ),
+                store=store,
+                lower_args=(
+                    (
+                        init_like_lowered, param_draws, w_draws,
+                        row_mask, it_ends,
+                    )
+                    if store is not None
+                    else None
+                ),
+                stats=pstats,
+            )
+            return fin(state_f, param_draws, w_draws, row_mask, it_ends)
+
         finalize = _cached_program(
             model,
             _finalize_key(

@@ -90,6 +90,35 @@ def _atomic_savez(path: str, arrays: dict) -> int:
     return size
 
 
+def sidecar_path(path: str, name: str) -> str:
+    """On-disk name of a named sidecar blob riding a manifest at
+    ``path`` (e.g. the adaptive scheduler's ``sched`` state, ISSUE
+    18). Deterministic like :func:`segment_path` — a resumed run
+    overwrites any orphan a killed predecessor left."""
+    return f"{path}.{name}.npz"
+
+
+def save_sidecar(path: str, name: str, arrays: dict) -> int:
+    """Atomically write a dict of numpy arrays as the ``name`` sidecar
+    of the manifest at ``path``; returns bytes written. Written BEFORE
+    the manifest each boundary: a crash between the two leaves a
+    sidecar one boundary AHEAD of the manifest, which is safe because
+    the consumer (the adaptive scheduler) stamps its state with the
+    last observed boundary and skips the duplicate fold when the
+    resumed run replays that chunk (observe() is idempotent per
+    boundary)."""
+    return _atomic_savez(
+        sidecar_path(path, name), {k: np.asarray(v) for k, v in arrays.items()}
+    )
+
+
+def load_sidecar(path: str, name: str) -> dict:
+    """Read a sidecar written by :func:`save_sidecar` into a plain
+    dict of numpy arrays. Raises FileNotFoundError when absent."""
+    with np.load(sidecar_path(path, name)) as data:
+        return {k: data[k].copy() for k in data.files}
+
+
 def segment_path(path: str, index: int) -> str:
     """On-disk name of draw segment ``index`` of the segmented checkpoint at
     ``path`` (the manifest). Deterministic so a resumed run OVERWRITES

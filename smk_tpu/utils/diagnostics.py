@@ -82,6 +82,89 @@ def rhat(chains: jnp.ndarray) -> jnp.ndarray:
     return jnp.sqrt(var_est / jnp.maximum(within, 1e-30))
 
 
+def masked_effective_sample_size(
+    chain: jnp.ndarray, mask: jnp.ndarray
+) -> jnp.ndarray:
+    """Geyer ESS over the VALID rows of a capacity-padded chain
+    (ISSUE 18, adaptive schedules): ``chain`` is (n, d) at buffer
+    capacity, ``mask`` (n,) flags the rows actually drawn (a frozen
+    subset's prefix; a reopened straggler's prefix-plus-tail). Rows
+    outside the mask contribute exactly zero to every moment — with a
+    contiguous all-valid mask this reduces to
+    :func:`effective_sample_size` on the valid prefix. Lag products
+    that straddle a reopen gap are zeroed rather than bridged, the
+    same documented autocorrelation approximation as the lenient
+    hole-refill path (parallel/recovery.py)."""
+    if chain.ndim == 1:
+        chain = chain[:, None]
+    n = chain.shape[0]
+    dt = chain.dtype
+    mk = mask.astype(dt)
+    cnt = jnp.maximum(jnp.sum(mk), jnp.asarray(1.0, dt))
+
+    def ess_one(x):
+        mean = jnp.sum(x * mk) / cnt
+        xc = (x - mean) * mk
+        nfft = 2 * n
+        f = jnp.fft.rfft(xc, nfft)
+        acov = jnp.fft.irfft(f * jnp.conj(f), nfft)[:n].real / cnt
+        var0 = jnp.maximum(acov[0], 1e-30)
+        rho = acov / var0
+        n_pairs = n // 2
+        pair = rho[0 : 2 * n_pairs : 2] + rho[1 : 2 * n_pairs : 2]
+        positive = pair > 0.0
+        keep = jnp.cumprod(positive.astype(x.dtype))
+        tau = -1.0 + 2.0 * jnp.sum(pair * keep)
+        tau = jnp.maximum(tau, 1.0 / cnt)
+        return cnt / tau
+
+    out = jax.vmap(ess_one, in_axes=1)(chain)
+    return jnp.minimum(out, cnt)
+
+
+def masked_rhat(chains: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Split-R-hat over the VALID rows of capacity-padded chains:
+    (C, n, d) + (n,) mask -> (d,). The valid draws (in buffer order)
+    are split into two equal halves of ``floor(count/2)`` rows by
+    VALID RANK — with an all-valid buffer this is exactly
+    :func:`rhat`'s fixed-index split — and the usual
+    pooled-over-within variance ratio follows. NaN while fewer than 4
+    valid draws exist (halves below 2 rows), matching the unmasked
+    guard."""
+    if chains.ndim == 2:
+        chains = chains[None]
+    c_ch = chains.shape[0]
+    dt = chains.dtype
+    one = jnp.asarray(1.0, dt)
+    mk = mask.astype(dt)
+    cnt = jnp.sum(mk)
+    h = jnp.floor(cnt / 2.0)
+    hf = jnp.maximum(h, one)
+    rank = jnp.cumsum(mk) - mk  # 0-based valid rank per row
+    m1 = mk * (rank < h).astype(dt)
+    m2 = mk * ((rank >= h) & (rank < 2.0 * h)).astype(dt)
+
+    def half_stats(mh):
+        mean = jnp.einsum("n,cnd->cd", mh, chains) / hf
+        dev = (chains - mean[:, None, :]) * mh[None, :, None]
+        var = jnp.einsum("cnd,cnd->cd", dev, dev) / jnp.maximum(
+            h - 1.0, one
+        )
+        return mean, var
+
+    mean1, var1 = half_stats(m1)
+    mean2, var2 = half_stats(m2)
+    means = jnp.concatenate([mean1, mean2])      # (2C, d)
+    within = jnp.mean(jnp.concatenate([var1, var2]), axis=0)
+    mu = jnp.mean(means, axis=0)
+    between = h * jnp.sum((means - mu) ** 2, axis=0) / jnp.asarray(
+        2 * c_ch - 1, dt
+    )
+    var_est = (h - 1.0) / hf * within + between / hf
+    r = jnp.sqrt(var_est / jnp.maximum(within, 1e-30))
+    return jnp.where(h >= 2.0, r, jnp.asarray(jnp.nan, dt))
+
+
 def split_rhat(chain: jnp.ndarray) -> jnp.ndarray:
     """Split-R-hat per column of an (n, d) single chain (split in 2).
 

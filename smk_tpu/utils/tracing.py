@@ -172,6 +172,11 @@ class ChunkPipelineStats:
     # pad_waste_frac bench/probe stamp top-level. None on host-path
     # (mesh-less) and equal-m runs.
     ragged_mesh_plan: Any = None
+    # adaptive-schedule ledger (ISSUE 18, parallel/schedule.py
+    # AdaptiveScheduler.summary()): per-subset freeze iterations and
+    # kept counts plus the dispatch-slot accounting — None on
+    # fixed-schedule runs.
+    adaptive: Any = None
     _lock: threading.Lock = field(
         default_factory=threading.Lock, repr=False
     )
@@ -385,6 +390,26 @@ class ChunkPipelineStats:
             # fit executed under (None off-mesh) — carries the
             # mesh-induced pad_waste_frac headline
             "ragged_mesh_plan": self.ragged_mesh_plan,
+            # ISSUE 18 adaptive-compute telemetry (None on fixed
+            # schedules): the scheduler ledger verbatim, plus the
+            # convergence-adjusted throughput the saved chunks buy —
+            # the bench A/B headline against ess_per_second
+            "adaptive": self.adaptive,
+            "chunks_saved_frac": (
+                self.adaptive.get("chunks_saved_frac")
+                if self.adaptive
+                else None
+            ),
+            "frozen_at": (
+                self.adaptive.get("frozen_at") if self.adaptive else None
+            ),
+            "ess_per_second_adaptive": (
+                round(self._ess_sum_final() / wall, 4)
+                if self.adaptive
+                and wall > 0
+                and self._ess_sum_final() is not None
+                else None
+            ),
             # ISSUE 7 fault-isolation accounting: policy, retry
             # ladder history, and the final dropped-subset set —
             # JSON-friendly (string subset ids) for bench/protocol

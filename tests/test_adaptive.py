@@ -1,0 +1,464 @@
+"""Adaptive compute tests (ISSUE 18): per-subset early stopping with
+active-set compaction and straggler budget reallocation.
+
+Three layers:
+
+1. Pure decision units — AdaptiveScheduler.observe is a host-side
+   pure function of committed boundary statistics, so freeze gating,
+   streak resets, the strict break-even grant ledger, budget-freeze /
+   reopen, idempotent replay and the sidecar round-trip are all
+   exercised in milliseconds with hand-fed boundaries.
+2. K'-ladder units — compile/buckets.k_ladder / compaction_rung
+   (rung selection, device-multiple ceiling, K cap).
+3. Integration on the shared m=16 problem (slow-marked: the cold
+   K'-ladder program set is a ~35 s compile bill): ONE cold adaptive
+   fit per module, the off-mode golden pin (adaptive_schedule="off"
+   must stay bit-identical to the pre-adaptive executor — pinned
+   sha), and a kill-at-freeze-boundary -> resume bit-identity leg on
+   the warm model. The in-gate tier carries layers 1–2 (host-math
+   milliseconds); protocol-grade evidence (mesh arm, recompile
+   guard, multi-boundary kill matrix, the same golden pin) lives in
+   scripts/adaptive_probe.py (ADAPT_r19.jsonl).
+"""
+
+# smklint: test-budget=in-gate tier is host math (ms); the slow-marked integration classes pay ONE cold adaptive fit + one off-mode fit (m=16, 80 iters), every other fit reusing the warm per-model program cache (~2-4 s each)
+
+import hashlib
+import os
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from smk_tpu.compile.buckets import compaction_rung, k_ladder
+from smk_tpu.config import SMKConfig
+from smk_tpu.models.probit_gp import SpatialProbitGP
+from smk_tpu.parallel.partition import random_partition
+from smk_tpu.parallel.recovery import fit_subsets_chunked
+from smk_tpu.parallel.schedule import SCHED_STATE_VERSION, AdaptiveScheduler
+from smk_tpu.utils.tracing import ChunkPipelineStats
+
+# Pinned off-mode digest: sha256 over (param_samples, w_samples,
+# param_grid, w_grid) of the m=16 reference fit below. Computed on the
+# pre-adaptive executor; adaptive_schedule="off" (the default) must
+# reproduce it bit-for-bit forever.
+GOLDEN_OFF_SHA = "c3c47b370ffe6fb5"
+
+
+def mk_sched(k=4, n_kept=40, chunk_iters=10, n_devices=1, **knobs):
+    base = dict(
+        n_subsets=4, n_samples=80, burn_in_frac=0.5,
+        live_diagnostics=True, adaptive_schedule="on",
+        target_rhat=1.1, target_ess=50.0, adapt_patience=2,
+        min_samples_before_stop=10, adapt_max_extra_frac=0.5,
+    )
+    base.update(knobs)
+    return AdaptiveScheduler(
+        SMKConfig(**base), k=k, n_kept=n_kept,
+        chunk_iters=chunk_iters, n_devices=n_devices,
+    )
+
+
+def obs(s, it, span, written, kc, rh, es, kind="samp", exhausted=False):
+    return s.observe(
+        kind=kind, it=it, span=span, written=written,
+        kc_dispatched=kc, rhat_max=np.asarray(rh, np.float64),
+        ess_min=np.asarray(es, np.float64), plan_exhausted=exhausted,
+    )
+
+
+GOOD = 1.05  # <= target_rhat=1.1
+BAD = 2.5
+
+
+class TestLadder:
+    def test_k_ladder_rungs(self):
+        assert k_ladder(1) == (1,)
+        assert k_ladder(4) == (1, 2, 3, 4)
+        assert k_ladder(6) == (1, 2, 3, 4, 6)
+        assert k_ladder(8) == (1, 2, 3, 4, 6, 8)
+
+    def test_top_rung_is_always_k(self):
+        for k in (1, 2, 3, 4, 5, 6, 7, 8, 12, 16):
+            assert k_ladder(k)[-1] == k
+
+    def test_compaction_rung_host(self):
+        assert compaction_rung(1, 4) == 1
+        assert compaction_rung(3, 4) == 3
+        assert compaction_rung(4, 4) == 4
+        assert compaction_rung(5, 6) == 6  # no rung 5 -> full K
+        assert compaction_rung(5, 8) == 6
+
+    def test_compaction_rung_device_ceiling_and_cap(self):
+        # ceiled to a device multiple, capped at K
+        assert compaction_rung(1, 4, n_devices=2) == 2
+        assert compaction_rung(3, 4, n_devices=2) == 4
+        assert compaction_rung(3, 8, n_devices=4) == 4
+        assert compaction_rung(5, 8, n_devices=4) == 8
+
+    def test_compaction_rung_rejects_bad_geometry(self):
+        with pytest.raises(ValueError):
+            compaction_rung(0, 4)
+        with pytest.raises(ValueError):
+            compaction_rung(5, 4)
+        with pytest.raises(ValueError):
+            compaction_rung(2, 6, n_devices=4)  # K % devices != 0
+
+
+class TestFreezeDecisions:
+    def test_patience_streak_gates_freeze(self):
+        s = mk_sched()  # patience=2, min_fill=10
+        d = obs(s, 50, (0, 10), range(4), 4, [GOOD] * 4, [99.0] * 4)
+        assert d.newly_frozen == () and d.active == (0, 1, 2, 3)
+        d = obs(s, 60, (10, 20), range(4), 4, [GOOD] * 4, [99.0] * 4)
+        assert d.newly_frozen == (0, 1, 2, 3)
+        assert d.active == () and d.all_done
+        assert s.frozen_at_it.tolist() == [60] * 4
+        assert s.frozen_at_count.tolist() == [20] * 4
+
+    def test_min_samples_before_stop_gates_freeze(self):
+        s = mk_sched(adapt_patience=1, min_samples_before_stop=15)
+        d = obs(s, 50, (0, 10), range(4), 4, [GOOD] * 4, [99.0] * 4)
+        assert d.newly_frozen == ()  # streak ok, only 10 kept draws
+        d = obs(s, 60, (10, 20), range(4), 4, [GOOD] * 4, [99.0] * 4)
+        assert d.newly_frozen == (0, 1, 2, 3)
+
+    def test_dirty_boundary_resets_streak(self):
+        s = mk_sched()
+        obs(s, 50, (0, 10), range(4), 4, [GOOD] * 4, [99.0] * 4)
+        d = obs(s, 60, (10, 20), range(4), 4,
+                [BAD, GOOD, GOOD, GOOD], [99.0] * 4)
+        assert d.newly_frozen == (1, 2, 3)
+        d = obs(s, 70, (20, 30), [0], 1, [GOOD, 1, 1, 1], [99.0] * 4)
+        assert d.newly_frozen == ()  # streak restarted at 1
+        d = obs(s, 80, (30, 40), [0], 1, [GOOD, 1, 1, 1], [99.0] * 4)
+        assert d.newly_frozen == (0,)
+
+    def test_nan_diagnostics_never_converge(self):
+        s = mk_sched(adapt_patience=1)
+        rh = [np.nan, GOOD, GOOD, GOOD]
+        es = [99.0, np.nan, 99.0, 99.0]
+        d = obs(s, 50, (0, 10), range(4), 4, rh, es)
+        assert d.newly_frozen == (2, 3)
+        d = obs(s, 60, (10, 20), [0, 1], 2, rh, es)
+        assert d.newly_frozen == () and d.active == (0, 1)
+
+    def test_low_ess_blocks_freeze(self):
+        s = mk_sched(adapt_patience=1, target_ess=50.0)
+        d = obs(s, 50, (0, 10), range(4), 4, [GOOD] * 4,
+                [10.0, 99.0, 99.0, 99.0])
+        assert d.newly_frozen == (1, 2, 3)
+
+
+class TestBudgetLedger:
+    def test_savings_fund_extra_chunks_strictly(self):
+        s = mk_sched(adapt_patience=1)
+        # 0,1,2 freeze at it=50; subset 3 straggles
+        obs(s, 50, (0, 10), range(4), 4, [GOOD] * 3 + [BAD], [99.0] * 4)
+        obs(s, 60, (10, 20), [3], 1, [1, 1, 1, BAD], [99.0] * 4)
+        obs(s, 70, (20, 30), [3], 1, [1, 1, 1, BAD], [99.0] * 4)
+        d = obs(s, 80, (30, 40), [3], 1, [1, 1, 1, BAD], [99.0] * 4,
+                exhausted=True)
+        assert s.saved_slots == 9 and s.spent_slots == 1
+        assert d.grant == (80, 10) and d.newly_budget_frozen == ()
+        assert s.pending_extras(80) == [(80, 10)]
+        # the granted chunk is pure spend: no savings accrue on it
+        d = obs(s, 90, (40, 50), [3], 1, [1, 1, 1, GOOD], [99.0] * 4,
+                kind="extra", exhausted=True)
+        assert s.saved_slots == 9 and s.spent_slots == 1
+        assert d.newly_frozen == (3,) and d.grant is None and d.all_done
+
+    def test_break_even_is_not_enough(self):
+        # saved == cost must NOT grant: the headline claim is a
+        # STRICT reduction in dispatched subset-chunks
+        s = mk_sched(adapt_patience=1)
+        obs(s, 50, (0, 10), range(4), 4, [GOOD] * 3 + [BAD], [99.0] * 4)
+        obs(s, 60, (10, 20), [3], 3, [1, 1, 1, BAD], [99.0] * 4)
+        d = obs(s, 70, (20, 30), [3], 3, [1, 1, 1, BAD], [99.0] * 4,
+                exhausted=True)
+        # saved = 0 + 1 + 1 = 2 > cost 1: grant. Re-run with no slack:
+        assert d.grant is not None
+        s2 = mk_sched(adapt_patience=1)
+        obs(s2, 50, (0, 10), range(4), 4, [GOOD] * 3 + [BAD], [99.0] * 4)
+        d = obs(s2, 60, (10, 20), [3], 3, [1, 1, 1, BAD], [99.0] * 4,
+                exhausted=True)
+        # saved = 1, cost 1: 1 + 0 is not < 1 -> budget-freeze instead
+        assert d.grant is None and d.newly_budget_frozen == (3,)
+        assert s2.frozen_at_it[3] == 60 and d.all_done
+
+    def test_extra_allowance_is_capped(self):
+        # adapt_max_extra_frac bounds TOTAL extra kept draws
+        s = mk_sched(adapt_patience=1, adapt_max_extra_frac=0.25)
+        assert s.n_extra_max == 2 and s.n_cap == 60
+        obs(s, 50, (0, 10), range(4), 4, [GOOD] * 3 + [BAD], [99.0] * 4)
+        obs(s, 60, (10, 20), [3], 1, [1, 1, 1, BAD], [99.0] * 4)
+        obs(s, 70, (20, 30), [3], 1, [1, 1, 1, BAD], [99.0] * 4)
+        it, n_extra = 80, 0
+        d = obs(s, it, (30, 40), [3], 1, [1, 1, 1, BAD], [99.0] * 4,
+                exhausted=True)
+        while d.grant is not None:
+            n_extra += 1
+            a = 40 + (n_extra - 1) * 10
+            it += 10
+            d = obs(s, it, (a, a + 10), [3], 1, [1, 1, 1, BAD],
+                    [99.0] * 4, kind="extra", exhausted=True)
+        assert n_extra == 2 and s.extra_granted == 2
+        # allowance (not budget) exhausted: no further grant is
+        # possible and the straggler's buffer is full to the brim
+        assert d.grant is None
+        assert s.counts()[3] == s.n_cap == 60
+
+    def test_grant_ranks_stragglers_by_worst_rhat(self):
+        s = mk_sched(adapt_patience=1, n_subsets=4)
+        obs(s, 50, (0, 10), range(4), 4, [GOOD, GOOD, BAD, BAD],
+            [99.0] * 4)
+        obs(s, 60, (10, 20), [2, 3], 2, [1, 1, BAD, BAD], [99.0] * 4)
+        d = obs(s, 70, (20, 30), [2, 3], 2, [1, 1, 2.0, 3.0],
+                [99.0] * 4, exhausted=True)
+        # saved = 2 + 2 = 4; take=2 costs rung(2)=2: 0+2 < 4 -> both
+        assert d.grant is not None
+        assert sorted(d.active) == [2, 3]
+        # unknown R-hat ranks WORST (never-diagnosed must not starve)
+        s2 = mk_sched(adapt_patience=1)
+        obs(s2, 50, (0, 10), range(4), 4, [GOOD, GOOD, BAD, BAD],
+            [99.0] * 4)
+        obs(s2, 60, (10, 20), [2, 3], 2, [1, 1, BAD, BAD], [99.0] * 4)
+        obs(s2, 70, (20, 30), [2, 3], 2, [1, 1, BAD, BAD], [99.0] * 4)
+        d = obs(s2, 80, (30, 40), [2, 3], 2, [1, 1, 2.0, np.nan],
+                [99.0, 99.0, 99.0, np.nan], exhausted=True)
+        assert 3 in d.active  # NaN-diagnosed straggler selected first
+
+
+class TestReplayAndSidecar:
+    def test_observe_is_idempotent_per_iteration(self):
+        s = mk_sched(adapt_patience=1)
+        obs(s, 50, (0, 10), range(4), 4, [GOOD] * 3 + [BAD], [99.0] * 4)
+        before = s.to_arrays()
+        # the crash-window replay: same boundary folded again
+        d = obs(s, 50, (0, 10), range(4), 4, [GOOD] * 3 + [BAD],
+                [99.0] * 4)
+        after = s.to_arrays()
+        for name in before:
+            np.testing.assert_array_equal(before[name], after[name])
+        assert d.active == (3,)
+
+    def test_sidecar_round_trip_is_exact(self):
+        s = mk_sched(adapt_patience=1)
+        obs(s, 50, (0, 10), range(4), 4, [GOOD] * 3 + [BAD], [99.0] * 4)
+        s.mark_stopped([0, 1, 2], 50)
+        obs(s, 60, (10, 20), [3], 1, [1, 1, 1, BAD], [99.0] * 4,
+            exhausted=True)
+        blobs = s.to_arrays()
+        assert int(blobs["version"]) == SCHED_STATE_VERSION
+        s2 = mk_sched(adapt_patience=1)
+        s2.restore_arrays(blobs)
+        for name, v in s.to_arrays().items():
+            np.testing.assert_array_equal(v, s2.to_arrays()[name])
+        assert s2.active_ids == s.active_ids
+        assert s2.pending_extras(60) == s.pending_extras(60)
+
+    def test_sidecar_geometry_mismatch_raises(self):
+        blobs = mk_sched().to_arrays()
+        with pytest.raises(ValueError, match="geometry"):
+            mk_sched(k=2, n_subsets=2).restore_arrays(blobs)
+        bad = dict(blobs)
+        bad["version"] = np.asarray(99, np.int64)
+        with pytest.raises(ValueError, match="version"):
+            mk_sched().restore_arrays(bad)
+
+    def test_summary_keys_and_chunks_saved_frac(self):
+        s = mk_sched(adapt_patience=1)
+        obs(s, 50, (0, 10), range(4), 4, [GOOD] * 3 + [BAD], [99.0] * 4)
+        obs(s, 60, (10, 20), [3], 1, [1, 1, 1, BAD], [99.0] * 4)
+        m = s.summary()
+        assert m["subset_chunks_baseline"] == 16
+        assert m["subset_chunks_dispatched"] == 5
+        assert m["chunks_saved_frac"] == pytest.approx(11 / 16)
+        assert m["n_frozen"] == 3
+        assert m["frozen_at"] == [50, 50, 50, -1]
+        assert m["kept_counts"] == [10, 10, 10, 20]
+
+
+class TestBudgetFreezeReopen:
+    def test_reopen_resets_departure_stamp(self):
+        """A budget-frozen straggler a later, richer grant can afford
+        REOPENS: it rejoins the active set and its physical-departure
+        stamp is cleared so finalize does not clamp its phi divisor
+        to the first exit (k=8 is the smallest ladder where the
+        strict ledger leaves enough slack after the first grant)."""
+        s = mk_sched(k=8, n_subsets=8, adapt_patience=1)
+        g, b = [GOOD] * 2, [BAD] * 6
+        ess = [99.0] * 8
+        obs(s, 50, (0, 10), range(8), 8, g + b, ess)       # 0,1 freeze
+        obs(s, 60, (10, 20), range(2, 8), 6, [1, 1] + [BAD] * 6, ess)
+        obs(s, 70, (20, 30), range(2, 8), 6, [1, 1] + [BAD] * 6, ess)
+        rh = [1, 1, GOOD, 2.5, 2.4, 2.3, 2.2, 2.1]
+        d = obs(s, 80, (30, 40), range(2, 8), 6, rh, ess,
+                exhausted=True)  # 2 freezes here; saved=6
+        # pool {3..7}: take5 costs rung(5)=6 (not < 6); take4 granted
+        assert d.grant == (80, 10)
+        assert sorted(d.active) == [3, 4, 5, 6]
+        assert d.newly_budget_frozen == (7,)
+        s.mark_stopped([7], 80)  # the executor's departure stamp
+        assert s.it_stopped[7] == 80
+        rh = [1, 1, 1, GOOD, GOOD, GOOD, GOOD, 2.1]
+        d = obs(s, 90, (40, 50), [3, 4, 5, 6], 4, rh, ess,
+                kind="extra", exhausted=True)
+        # 3..6 converge on the extra; spent 4 + rung(1) < saved 6
+        assert d.newly_reopened == (7,)
+        assert d.grant == (90, 10) and d.active == (7,)
+        assert not s.budget_frozen[7]
+        assert s.it_stopped[7] == -1  # stamp cleared on re-entry
+        assert s.frozen_at_it[7] == -1
+        rh = [1] * 7 + [GOOD]
+        d = obs(s, 100, (50, 60), [7], 1, rh, ess, kind="extra",
+                exhausted=True)
+        assert d.newly_frozen == (7,) and d.all_done
+
+    def test_scheduler_state_carries_no_quarantine_fields(self):
+        """The reopen path may touch ONLY scheduler state: the
+        sidecar blob set shares nothing with the quarantine retry
+        bookkeeping (attempts/domain_attempts live in the checkpoint
+        manifest), so a freeze/reopen cycle cannot reset a retry
+        ladder by construction (tests/test_fault_isolation.py drives
+        the integration arms)."""
+        names = set(mk_sched().to_arrays())
+        assert not names & {
+            "attempts", "retry_attempts", "domain_attempts", "dead",
+        }
+
+
+# --------------------------------------------------------------------
+# integration: shared m=16 problem, one cold program set per mode
+# --------------------------------------------------------------------
+
+N_KEPT = 40  # n_samples=80, burn_in_frac=0.5
+
+ADAPTIVE_CFG = SMKConfig(
+    n_subsets=4, n_samples=80, burn_in_frac=0.5, live_diagnostics=True,
+    adaptive_schedule="on", target_rhat=1.5, target_ess=8.0,
+    adapt_patience=1, min_samples_before_stop=8,
+    adapt_max_extra_frac=0.5, n_chains=2,
+)
+OFF_CFG = SMKConfig(
+    n_subsets=4, n_samples=80, burn_in_frac=0.5, live_diagnostics=True,
+)
+
+
+@pytest.fixture(scope="module")
+def problem():
+    rng = np.random.default_rng(7)
+    n, q, p, t = 64, 1, 2, 5
+    coords = jnp.asarray(rng.uniform(size=(n, 2)), jnp.float32)
+    x = jnp.asarray(rng.normal(size=(n, q, p)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, size=(n, q)), jnp.float32)
+    ct = jnp.asarray(rng.uniform(size=(t, 2)), jnp.float32)
+    xt = jnp.asarray(rng.normal(size=(t, q, p)), jnp.float32)
+    part = random_partition(jax.random.key(0), y, x, coords, 4)
+    return part, ct, xt
+
+
+@pytest.fixture(scope="module")
+def adaptive_model():
+    return SpatialProbitGP(ADAPTIVE_CFG, weight=1)
+
+
+@pytest.fixture(scope="module")
+def adaptive_fit(problem, adaptive_model):
+    """The module's one cold adaptive fit (pays the K'-ladder program
+    set); later tests re-dispatch the warm model."""
+    part, ct, xt = problem
+    ps = ChunkPipelineStats()
+    res = fit_subsets_chunked(
+        adaptive_model, part, ct, xt, jax.random.key(1), None,
+        chunk_iters=10, pipeline_stats=ps,
+    )
+    return res, ps
+
+
+# slow-marked: the adaptive_fit fixture pays the one cold K'-ladder
+# program set (~35 s) — the scheduler units above carry the decision
+# logic in-gate, and scripts/adaptive_probe.py (ADAPT_r19.jsonl) runs
+# this exact integration matrix as the protocol record
+@pytest.mark.slow
+class TestAdaptiveRun:
+    def test_freezes_and_strictly_fewer_chunks(self, adaptive_fit):
+        res, ps = adaptive_fit
+        ad = ps.adaptive
+        assert ad["n_frozen"] >= 1
+        assert all(f >= 0 for f in ad["frozen_at"])
+        assert (
+            ad["subset_chunks_dispatched"] < ad["subset_chunks_baseline"]
+        )
+        assert ad["chunks_saved_frac"] > 0
+        assert np.isfinite(np.asarray(res.param_samples)).any()
+
+    def test_extra_draws_land_beyond_base_allocation(self, adaptive_fit):
+        _, ps = adaptive_fit
+        ad = ps.adaptive
+        assert ad["extra_granted"] >= 1
+        assert max(ad["kept_counts"]) > N_KEPT
+        assert ad["spent_slots"] < ad["saved_slots"]
+
+    def test_aggregate_surfaces_adaptive_telemetry(self, adaptive_fit):
+        _, ps = adaptive_fit
+        agg = ps.aggregate()
+        assert agg["chunks_saved_frac"] == ps.adaptive["chunks_saved_frac"]
+        assert agg["frozen_at"] == ps.adaptive["frozen_at"]
+        assert agg["ess_per_second_adaptive"] is not None
+
+    def test_kill_at_freeze_boundary_resume_bit_identical(
+        self, problem, adaptive_model, adaptive_fit, tmp_path
+    ):
+        """Kill exactly at the boundary where the first freeze and
+        compaction fire (chunk 6 = iteration 60 here), resume from
+        the checkpoint + scheduler sidecar: every output leaf is
+        bit-identical to the uninterrupted fit. The pre-/post-freeze
+        kill matrix runs in scripts/adaptive_probe.py."""
+        part, ct, xt = problem
+        full, _ = adaptive_fit
+        cp = str(tmp_path / "ck.npz")
+        killed = fit_subsets_chunked(
+            adaptive_model, part, ct, xt, jax.random.key(1), None,
+            chunk_iters=10, checkpoint_path=cp, stop_after_chunks=6,
+        )
+        assert killed is None and os.path.exists(cp)
+        resumed = fit_subsets_chunked(
+            adaptive_model, part, ct, xt, jax.random.key(1), None,
+            chunk_iters=10, checkpoint_path=cp,
+        )
+        for fl, rl in zip(
+            jax.tree_util.tree_leaves(full),
+            jax.tree_util.tree_leaves(resumed),
+        ):
+            np.testing.assert_array_equal(np.asarray(fl), np.asarray(rl))
+
+
+def test_result_fields_exist_on_api_surface():
+    from smk_tpu.api import MetaKrigingResult
+
+    assert {"frozen_at", "chunks_saved_frac"} <= set(
+        MetaKrigingResult._fields
+    )
+
+
+# slow-marked: one full off-mode fit (~10 s) — the identical golden
+# pin gates every probe run in-process (scripts/adaptive_probe.py
+# off_identity leg, matches_golden_pin)
+@pytest.mark.slow
+class TestOffModeGolden:
+    def test_off_mode_matches_pre_adaptive_pin(self, problem):
+        """adaptive_schedule="off" (the default) must be bit-identical
+        to the executor as it existed before the adaptive scheduler:
+        the pinned sha over all four output surfaces."""
+        part, ct, xt = problem
+        res = fit_subsets_chunked(
+            SpatialProbitGP(OFF_CFG, weight=1), part, ct, xt,
+            jax.random.key(1), None, chunk_iters=20,
+        )
+        h = hashlib.sha256()
+        for a in (res.param_samples, res.w_samples, res.param_grid,
+                  res.w_grid):
+            h.update(np.ascontiguousarray(np.asarray(a)).tobytes())
+        assert h.hexdigest()[:16] == GOLDEN_OFF_SHA

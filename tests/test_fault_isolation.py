@@ -607,3 +607,125 @@ class TestUnits:
         with warnings.catch_warnings():
             warnings.simplefilter("error")
             w2.close()
+
+
+# --------------------------------------------------------------------
+# quarantine / adaptive-schedule interplay (ISSUE 18)
+# --------------------------------------------------------------------
+
+ADAPT_Q_CFG = SMKConfig(
+    n_subsets=K, n_samples=80, burn_in_frac=0.5, live_diagnostics=True,
+    adaptive_schedule="on", target_rhat=1.5, target_ess=8.0,
+    adapt_patience=1, min_samples_before_stop=8,
+    adapt_max_extra_frac=0.5, n_chains=2, fault_policy="quarantine",
+)
+ADAPT_CHUNK = 10
+
+
+@pytest.fixture(scope="module")
+def adaptive_q_model():
+    return SpatialProbitGP(ADAPT_Q_CFG, weight=1)
+
+
+@pytest.fixture(scope="module")
+def adaptive_q_golden(problem, adaptive_q_model):
+    """Uninjected adaptive+quarantine reference: subset 0 freezes at
+    iteration 60, the group compacts to the K'=3 rung [1, 2, 3], and
+    one extra chunk is granted to the straggler."""
+    part, ct, xt, key = problem
+    ps = ChunkPipelineStats()
+    res = fit_subsets_chunked(
+        adaptive_q_model, part, ct, xt, key,
+        chunk_iters=ADAPT_CHUNK, pipeline_stats=ps,
+    )
+    ad = ps.adaptive
+    assert ad["frozen_at"][0] == 60 and ad["n_frozen"] >= 1
+    return res, ad
+
+
+# slow-marked: the adaptive_q_golden fixture pays the adaptive
+# K'-ladder program set for this module's quarantine config (~25 s);
+# the quarantine engine's in-gate coverage above carries the rewind/
+# retry machinery, and the interplay contract re-runs with every
+# slow tier + scripts/adaptive_probe.py protocol
+@pytest.mark.slow
+class TestAdaptiveInterplay:
+    def test_frozen_subset_excluded_from_rewind(
+        self, problem, adaptive_q_model, adaptive_q_golden
+    ):
+        """Arm 1 of the interplay contract: once a subset freezes, it
+        is never a rewind candidate — its chunk-start hold is
+        released, it leaves the dispatch group, and a fault in the
+        compacted group cannot touch its committed draws. Here subset
+        0 freezes at it=60 (group compacts to [1, 2, 3]); a NaN
+        planted in the compacted chunk [60, 70) poisons dispatch ROW
+        0, which the guard expansion maps to SUBSET 1 — subset 1
+        retries on its own ladder while frozen subset 0's draws stay
+        bit-identical to the uninjected run."""
+        part, ct, xt, key = problem
+        ref, ref_ad = adaptive_q_golden
+        ps = ChunkPipelineStats()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            with inject_subset_nan(0, 62, max_fires=1) as inj:
+                res = fit_subsets_chunked(
+                    adaptive_q_model, part, ct, xt, key,
+                    chunk_iters=ADAPT_CHUNK, pipeline_stats=ps,
+                )
+        assert inj.fires == 1
+        f = ps.fault_summary()
+        # the retry is attributed to subset 1 (row 0 of the compacted
+        # group), NOT the frozen subset 0
+        assert f["retry_attempts"] == {"1": 1}
+        assert f["subsets_dropped"] == []
+        # the frozen subset froze at the same boundary with the same
+        # kept count and its draws are untouched by the later fault
+        assert ps.adaptive["frozen_at"][0] == ref_ad["frozen_at"][0]
+        assert ps.adaptive["kept_counts"][0] == ref_ad["kept_counts"][0]
+        np.testing.assert_array_equal(
+            np.asarray(ref.param_samples)[0],
+            np.asarray(res.param_samples)[0],
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ref.w_samples)[0], np.asarray(res.w_samples)[0]
+        )
+        assert np.isfinite(np.asarray(res.param_samples)[1]).all()
+
+    def test_retry_ladder_intact_through_freeze_cycle(
+        self, problem, adaptive_q_model, adaptive_q_golden
+    ):
+        """Arm 2: the quarantine retry ladder and the adaptive
+        schedule compose without coupling. A subset that spends a
+        retry BEFORE converging still freezes later (the forked-key
+        replay re-enters the schedule as ordinary committed
+        boundaries), the run completes with every chain finite, and
+        subsets the fault never touched stay bit-identical — the
+        freeze/reopen cycle cannot reset or consume retry budget
+        (the scheduler sidecar carries no quarantine state;
+        tests/test_adaptive.py pins the blob layout)."""
+        part, ct, xt, key = problem
+        ref, _ = adaptive_q_golden
+        ps = ChunkPipelineStats()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            # it=45 is inside the first full-K sampling chunk
+            # [40, 50): row 2 == subset 2, pre-freeze
+            with inject_subset_nan(2, 45, max_fires=1) as inj:
+                res = fit_subsets_chunked(
+                    adaptive_q_model, part, ct, xt, key,
+                    chunk_iters=ADAPT_CHUNK, pipeline_stats=ps,
+                )
+        assert inj.fires == 1
+        f = ps.fault_summary()
+        assert f["retry_attempts"] == {"2": 1}
+        assert f["subsets_dropped"] == []
+        # the retried subset still reaches a frozen verdict on its
+        # replayed (legitimately different) chain
+        assert ps.adaptive["frozen_at"][2] >= 0
+        assert np.isfinite(np.asarray(res.param_samples)[2]).all()
+        # share-nothing replay: subset 0 (frozen before nothing — the
+        # fault precedes every freeze) is bit-identical anyway
+        np.testing.assert_array_equal(
+            np.asarray(ref.param_samples)[0],
+            np.asarray(res.param_samples)[0],
+        )

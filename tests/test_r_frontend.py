@@ -372,6 +372,58 @@ class TestScaleOutKnobs:
         assert "pad_waste_frac" in MetaKrigingResult._fields
 
 
+class TestAdaptiveKnobs:
+    def test_adaptive_schedule_knobs_wired(self):
+        """The ISSUE 18 front-end additions: R ``adaptive.schedule``
+        (match.arg over off/on, off first = bit-identical default),
+        ``target.rhat`` / ``target.ess`` /
+        ``adapt.max.extra.frac`` (SMKConfig defaults) must exist and
+        feed the matching SMKConfig fields, and the result list must
+        carry ``$frozen.at`` / ``$chunks.saved.frac`` from the
+        Python result's fields (which really exist) — source-checked
+        like the ISSUE 12/15/17 knob wirings, plus the config-side
+        validation the R values route through."""
+        import os
+
+        from smk_tpu.config import SMKConfig
+
+        r_src = open(
+            os.path.join(
+                os.path.dirname(os.path.dirname(__file__)),
+                "r", "meta_kriging_tpu.R",
+            )
+        ).read()
+        assert 'adaptive.schedule = c("off", "on")' in r_src
+        assert "target.rhat = 1.05" in r_src
+        assert "target.ess = 100" in r_src
+        assert "adapt.max.extra.frac = 0.5" in r_src
+        assert "adaptive.schedule <- match.arg(adaptive.schedule)" \
+            in r_src
+        assert "adaptive_schedule = adaptive.schedule" in r_src
+        assert "target_rhat = target.rhat" in r_src
+        assert "target_ess = target.ess" in r_src
+        assert "adapt_max_extra_frac = adapt.max.extra.frac" in r_src
+        assert "chunks.saved.frac = res$chunks_saved_frac" in r_src
+        assert "frozen.at = if (is.null(res$frozen_at)) NULL" in r_src
+        # the Python result fields the R list reads really exist
+        from smk_tpu.api import MetaKrigingResult
+
+        assert "frozen_at" in MetaKrigingResult._fields
+        assert "chunks_saved_frac" in MetaKrigingResult._fields
+        # the R defaults match SMKConfig's (the off default keeps
+        # every existing R workflow bit-identical), and the values R
+        # sends route through the config-side validation
+        cfg = SMKConfig()
+        assert cfg.adaptive_schedule == "off"
+        assert cfg.target_rhat == 1.05
+        assert cfg.target_ess == 100.0
+        assert cfg.adapt_max_extra_frac == 0.5
+        with pytest.raises(ValueError, match="adaptive_schedule"):
+            SMKConfig(adaptive_schedule="sometimes")
+        with pytest.raises(ValueError, match="target_rhat"):
+            SMKConfig(target_rhat=1.0)
+
+
 class TestResilienceKnobs:
     def test_watchdog_and_dist_init_args_wired(self):
         """The ISSUE 11 front-end additions: R ``watchdog`` and

@@ -1456,10 +1456,105 @@ class TestDeviceLayout:
         assert "SMK117" in rules_hit(broken, path=real)
 
 
+class TestScheduleDiscipline:
+    """SMK118 (ISSUE 18): the adaptive early-stop policy lives in ONE
+    place — AdaptiveScheduler reads the decision knobs, the chunked
+    executor consults it at committed boundaries.  Knob reads,
+    observe() consults, and scheduler construction anywhere else are
+    a second (non-replayable) policy and are banned."""
+
+    def test_knob_read_flagged(self):
+        src = (
+            "def f(cfg, rhat):\n"
+            "    if rhat <= cfg.target_rhat:\n"
+            "        return True\n"
+            "    return cfg.adapt_patience > 0\n"
+        )
+        assert lines_hit(src, "SMK118") == [2, 4]
+
+    def test_all_five_knobs_covered_gate_excluded(self):
+        src = (
+            "def f(cfg):\n"
+            "    a = cfg.target_rhat\n"
+            "    b = cfg.target_ess\n"
+            "    c = cfg.adapt_patience\n"
+            "    d = cfg.min_samples_before_stop\n"
+            "    e = cfg.adapt_max_extra_frac\n"
+            "    on = cfg.adaptive_schedule == 'on'\n"
+            "    return a, b, c, d, e, on\n"
+        )
+        # the on/off gate is how callers are SUPPOSED to branch
+        assert lines_hit(src, "SMK118") == [2, 3, 4, 5, 6]
+
+    def test_observe_consult_flagged_outside_executor(self):
+        src = (
+            "def f(sched, it):\n"
+            "    return sched.observe('samp', it, 10, 10, 4, 1.0, 9.0, False)\n"
+        )
+        assert "SMK118" in rules_hit(src)
+        # non-scheduler .observe() targets are not the consult site
+        clean = "def f(watcher):\n    return watcher.observe('tick')\n"
+        assert "SMK118" not in rules_hit(clean)
+
+    def test_ctor_flagged_outside_sanctioned_zones(self):
+        src = (
+            "from smk_tpu.parallel.schedule import AdaptiveScheduler\n"
+            "def f(cfg):\n"
+            "    return AdaptiveScheduler(cfg, k=4, n_kept=40, chunk_iters=10)\n"
+        )
+        assert "SMK118" in rules_hit(src)
+
+    def test_sanctioned_zones_exempt(self):
+        knob = "def f(cfg):\n    return cfg.target_rhat\n"
+        for zone in ("smk_tpu/parallel/schedule.py", "smk_tpu/config.py"):
+            assert "SMK118" not in rules_hit(knob, path=zone), zone
+        consult = (
+            "def f(sched):\n"
+            "    return sched.observe('samp', 0, 1, 1, 4, 1.0, 9.0, False)\n"
+        )
+        assert "SMK118" not in rules_hit(
+            consult, path="smk_tpu/parallel/recovery.py"
+        )
+        ctor = "def f(cfg):\n    return AdaptiveScheduler(cfg, k=4)\n"
+        for zone in (
+            "smk_tpu/parallel/recovery.py",
+            "smk_tpu/compile/warmup.py",
+        ):
+            assert "SMK118" not in rules_hit(ctor, path=zone), zone
+
+    def test_outside_smk_tpu_clean(self):
+        src = (
+            "def f(cfg, sched):\n"
+            "    if cfg.target_rhat < 1.1:\n"
+            "        sched.observe('samp', 0, 1, 1, 4, 1.0, 9.0, False)\n"
+        )
+        assert "SMK118" not in rules_hit(src, path=SCRIPT_PATH)
+        assert "SMK118" not in rules_hit(src, path=TESTS_PATH)
+
+    def test_suppression_with_justification(self):
+        src = (
+            "def f(cfg):\n"
+            "    return cfg.target_rhat  "
+            "# smklint: disable=SMK118 -- display-only echo of the knob\n"
+        )
+        hits = rules_hit(src)
+        assert "SMK118" not in hits and "SMK100" not in hits
+
+    def test_real_recovery_clean_and_seeded_defect_caught(self):
+        real = "smk_tpu/parallel/recovery.py"
+        src = repo_file(real)
+        assert "SMK118" not in rules_hit(src, path=real)
+        broken = src + (
+            "\n\ndef _stop_early(cfg, rhat):\n"
+            "    return rhat <= cfg.target_rhat\n"
+        )
+        assert "SMK118" in rules_hit(broken, path=real)
+
+
 @pytest.mark.parametrize("rule_id", [
     "SMK101", "SMK102", "SMK103", "SMK104", "SMK105", "SMK106",
     "SMK107", "SMK108", "SMK109", "SMK110", "SMK111", "SMK112",
-    "SMK113", "SMK114", "SMK115", "SMK116", "SMK117",
+    "SMK113", "SMK114", "SMK115", "SMK116", "SMK117", "SMK118",
 ])
 def test_every_rule_documented_in_catalogue(rule_id):
     from smk_tpu.analysis.lint import _list_rules
