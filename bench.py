@@ -137,6 +137,21 @@ ragged_mesh_plan, and the mesh-induced pad_waste_frac
 (BENCH_MESH_DEVICES sizes the mesh; scripts/ragged_probe.py --mesh
 is the subprocess-isolated sibling → RAGGED_MESH_r18.jsonl).
 
+BENCH_INGEST=1 appends the ISSUE 19 live-fleet rung: a LiveFit
+(smk_tpu/serve/ingest.py) runs the closed fit→ingest→re-fit loop —
+initial coherent fit published as generation 0, a corner-targeted
+batch ingested, ONLY the dirty subsets re-fit warm-started from
+carried state, the next generation two-phase committed — stamping
+``ingest_to_visible_s`` (ingest call → new generation committed),
+``refit_speedup`` (warm full-refit wall over warm dirty-refit wall
+at the SAME per-subset MCMC schedule — matched convergence floor by
+construction), ``dirty_group_frac`` and the committed ``generation``.
+BENCH_INGEST_N / BENCH_INGEST_K / BENCH_INGEST_ITERS /
+BENCH_INGEST_BATCH resize it (scripts/ingest_probe.py is the
+subprocess-isolated chaos sibling → INGEST_r20.jsonl: untouched-
+subset bit-identity, warm >2x speedup, kill-mid-publish rollback,
+serve-during-swap never-torn).
+
 Synthetic latent surfaces use random Fourier features (an O(n)
 stationary GP approximation) so data generation never needs an n x n
 factorization.
@@ -1540,6 +1555,106 @@ def run_rung_adaptive(name, *, solver_env=None, n=None, k=None,
     return out
 
 
+def run_rung_ingest(name, *, solver_env=None, n=None, k=None,
+                    n_samples=None, n_test=32):
+    """BENCH_INGEST=1 (ISSUE 19): the live-fleet ingest/re-fit rung.
+
+    One LiveFit runs the closed loop: initial coherent fit
+    (generation 0), a corner-targeted ingest batch (dirty subsets =
+    the batch's Morton routes only), and the dirty-only re-fit that
+    publishes generation 1. The speedup contract is measured on WARM
+    walls — full refit twice and dirty refit twice, the second of
+    each timed — so one-time program compiles don't pollute the
+    ratio; both arms run the IDENTICAL per-subset MCMC schedule, so
+    the convergence floor is matched by construction.
+    ``ingest_to_visible_s`` is the cold end-to-end number an
+    operator feels: ingest() call → the new generation committed and
+    loadable. BENCH_INGEST_N / BENCH_INGEST_K / BENCH_INGEST_ITERS /
+    BENCH_INGEST_BATCH resize (scripts/ingest_probe.py is the
+    subprocess-isolated chaos sibling emitting INGEST_r20.jsonl)."""
+    import dataclasses
+    import tempfile
+
+    from smk_tpu.serve.ingest import LiveFit
+    from smk_tpu.utils.tracing import ChunkPipelineStats
+
+    env = solver_env or {}
+    n = n or int(os.environ.get("BENCH_INGEST_N", 1024))
+    k = k or int(os.environ.get("BENCH_INGEST_K", 8))
+    n_samples = n_samples or int(
+        os.environ.get("BENCH_INGEST_ITERS", 240)
+    )
+    batch = int(os.environ.get("BENCH_INGEST_BATCH", 32))
+    n_all = n + n_test
+    y, x, coords = make_binary_field(jax.random.key(3), n_all)
+    y, x, coords, coords_test, x_test = (
+        np.asarray(y[:n]), np.asarray(x[:n]), np.asarray(coords[:n]),
+        np.asarray(coords[n:]), np.asarray(x[n:]),
+    )
+    cfg = rung_config(
+        env, k=k, n_samples=n_samples,
+        cov_model="exponential", link="probit",
+    )
+    cfg = dataclasses.replace(cfg, partition_method="coherent")
+    gen_dir = tempfile.mkdtemp(prefix="smk_bench_ingest_")
+    pstats = ChunkPipelineStats()
+    live = LiveFit(
+        gen_dir, config=cfg, coords_test=coords_test, x_test=x_test,
+        pipeline_stats=pstats,
+    )
+    t0 = time.time()
+    manifest0 = live.fit(jax.random.key(2), y, x, coords)
+    fit_wall = time.time() - t0
+
+    # the ingest batch: duplicates of subset 0's own rows — provably
+    # routes to subset 0 alone (same Morton codes under the frozen
+    # frame), so dirty_group_frac is the honest small fraction
+    rng = np.random.default_rng(11)
+    own = np.asarray(live._assignments[0][:batch], np.int64)
+    c_new = live._coords[own]
+    y_new = rng.integers(0, 2, size=(len(own), y.shape[1])).astype(
+        np.float64
+    )
+    x_new = rng.normal(size=(len(own),) + x.shape[1:])
+
+    t0 = time.time()
+    receipt = live.ingest(y_new, x_new, c_new)
+    rep_cold = live.refit(jax.random.key(4))
+    ingest_to_visible = time.time() - t0
+    dirty = list(rep_cold.refit_subsets)
+
+    # warm walls: second identical-shape run of each arm
+    live.refit(jax.random.key(5), full=True)
+    rep_full = live.refit(jax.random.key(6), full=True)
+    live.refit(jax.random.key(7), subsets=dirty)
+    rep_dirty = live.refit(jax.random.key(8), subsets=dirty)
+    speedup = (
+        rep_full.refit_wall_s / rep_dirty.refit_wall_s
+        if rep_dirty.refit_wall_s > 0 else None
+    )
+    art, manifest = live.load_current()
+    out = {
+        "rung": name, "n": n, "K": k, "iters": n_samples,
+        "public_path": True, "ingest_batch": int(receipt.n_rows),
+        "fit_wall_s": round(fit_wall, 2),
+        "ingest_to_visible_s": round(ingest_to_visible, 2),
+        "dirty_subsets": dirty,
+        "dirty_group_frac": round(rep_cold.dirty_group_frac, 4),
+        "wall_full_warm_s": round(rep_full.refit_wall_s, 2),
+        "wall_dirty_warm_s": round(rep_dirty.refit_wall_s, 2),
+        "refit_speedup": round(speedup, 2) if speedup else None,
+        "refit_rhat_max": rep_dirty.param_rhat_max,
+        "generation": int(manifest["generation"]),
+        "ingest_ledger": pstats.ingest,
+        "finite": bool(
+            np.isfinite(np.asarray(art.sample_w)).all()
+            and np.isfinite(np.asarray(art.param_grid)).all()
+        ),
+    }
+    live.close()
+    return out
+
+
 def run_rung(name, *, n, k, cov_model, n_samples, q=1, p=2, n_test=64,
              seed=0, solver_env=None, make_data=None, link="probit",
              budget_left=None, progress=None):
@@ -2672,6 +2787,25 @@ def main():
         except Exception as e:
             reporter.ladder.append(
                 {"rung": "adaptive_ab", "error": repr(e)}
+            )
+            reporter.emit(partial=True)
+
+    # Live-fleet rung (ISSUE 19): BENCH_INGEST=1 appends the closed
+    # fit→ingest→re-fit loop cell — ingest_to_visible_s (ingest call
+    # → new generation committed), the warm refit_speedup (full wall
+    # over dirty wall, identical MCMC schedule both arms),
+    # dirty_group_frac and the committed generation
+    # (scripts/ingest_probe.py is the chaos-protocol sibling emitting
+    # INGEST_r20.jsonl). Reporter-first fallible like every probe
+    # cell.
+    if os.environ.get("BENCH_INGEST", "0") == "1":
+        try:
+            reporter.add_rung(run_rung_ingest(
+                "ingest_refit", solver_env=env,
+            ))
+        except Exception as e:
+            reporter.ladder.append(
+                {"rung": "ingest_refit", "error": repr(e)}
             )
             reporter.emit(partial=True)
 

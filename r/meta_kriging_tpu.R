@@ -489,3 +489,146 @@ smk.predict.serve <- function(artifact.path, coords.query, x.query,
     health = eng$health()
   )
 }
+
+# ---------------------------------------------------------------------------
+# Live fleet: streaming ingest + incremental dirty-group re-fits (ISSUE 19)
+# ---------------------------------------------------------------------------
+# smk.live.fit opens a LiveFit — the growable dataset, its Morton-
+# coherent partition, and the generation directory the fleet serves
+# from — and runs the initial fit (publishes generation 0).
+# smk.ingest appends a batch of new observations: each row routes to
+# its Morton subset deterministically, only the touched subsets are
+# marked dirty, and NOTHING republishes (the fleet keeps serving).
+# smk.refit re-fits ONLY the dirty subsets warm-started from the
+# carried combined posterior, splices them into the untouched
+# subsets' bitwise-carried draws, re-runs the combiner, and publishes
+# the next generation ($generation on the result; $refit.speedup is
+# the full-fit wall over this dirty-only wall at the SAME per-subset
+# MCMC schedule — a like-for-like ratio). Swap a serving engine onto
+# the new generation with smk.predict.serve against the new
+# artifact, or via the Python API's engine$swap_artifact.
+# One live fit per gen.dir per R session (the partition, router and
+# carried posteriors live on the handle).
+.smk.live.fits <- new.env(parent = emptyenv())
+
+smk.live.fit <- function(gen.dir, y, x, coords, coords.test, x.test,
+                         weight = 1, n.core = 20,
+                         n.samples = 5000, burn.in = 0.75,
+                         cov.model = "exponential",
+                         combiner = "wasserstein_mean",
+                         link = c("probit", "logit"),
+                         bucket.ladder = NULL,
+                         run.log.dir = NULL,
+                         backend = c("tpu", "cpu"),
+                         seed = 0L,
+                         config.overrides = list()) {
+  link <- match.arg(link)
+  backend <- match.arg(backend)
+  if (!requireNamespace("reticulate", quietly = TRUE)) {
+    stop("the TPU backend needs the 'reticulate' package")
+  }
+  if (is.matrix(y) || is.numeric(y)) y <- list(y)
+  if (is.matrix(x)) x <- list(x)
+  if (is.matrix(x.test)) x.test <- list(x.test)
+  y_arr <- sapply(y, as.numeric)
+  x_arr <- aperm(simplify2array(x), c(1, 3, 2))
+  xt_arr <- aperm(simplify2array(x.test), c(1, 3, 2))
+
+  jax <- reticulate::import("jax")
+  if (backend == "cpu") {
+    jax$config$update("jax_platforms", "cpu")
+  }
+  smk <- reticulate::import("smk_tpu")
+  serve <- reticulate::import("smk_tpu.serve")
+  cfg_args <- utils::modifyList(list(
+    n_subsets = as.integer(n.core),
+    n_samples = as.integer(n.samples),
+    burn_in_frac = burn.in,
+    cov_model = cov.model,
+    combiner = combiner,
+    link = link,
+    # the ingest router IS the coherent partition's Morton code
+    # arithmetic — LiveFit refuses any other partition.method
+    partition_method = "coherent",
+    bucket_ladder = if (is.null(bucket.ladder)) NULL else
+      as.integer(bucket.ladder),
+    run_log_dir = run.log.dir
+  ), config.overrides)
+  cfg <- do.call(smk$SMKConfig, cfg_args)
+  live <- serve$LiveFit(
+    gen.dir, config = cfg,
+    coords_test = reticulate::np_array(coords.test, dtype = "float64"),
+    x_test = reticulate::np_array(xt_arr, dtype = "float64"),
+    weight = as.integer(weight)
+  )
+  manifest <- live$fit(
+    jax$random$key(as.integer(seed)),
+    reticulate::np_array(y_arr, dtype = "float64"),
+    reticulate::np_array(x_arr, dtype = "float64"),
+    reticulate::np_array(coords, dtype = "float64")
+  )
+  assign(gen.dir, live, envir = .smk.live.fits)
+  list(
+    generation = as.integer(manifest$generation),
+    artifact = manifest$artifact,
+    n.rows = live$n_rows,
+    subset.sizes = as.integer(unlist(live$subset_sizes)),
+    gen.dir = gen.dir
+  )
+}
+
+.smk.live.get <- function(gen.dir) {
+  live <- get0(gen.dir, envir = .smk.live.fits)
+  if (is.null(live)) {
+    stop(sprintf(
+      "no live fit open for '%s' in this session — call smk.live.fit first",
+      gen.dir
+    ))
+  }
+  live
+}
+
+smk.ingest <- function(gen.dir, y.new, x.new = NULL, coords.new) {
+  live <- .smk.live.get(gen.dir)
+  if (is.matrix(y.new) || is.numeric(y.new)) y.new <- list(y.new)
+  y_arr <- sapply(y.new, as.numeric)
+  args <- list(
+    reticulate::np_array(y_arr, dtype = "float64"),
+    coords_new = reticulate::np_array(coords.new, dtype = "float64")
+  )
+  if (!is.null(x.new)) {
+    if (is.matrix(x.new)) x.new <- list(x.new)
+    xb_arr <- aperm(simplify2array(x.new), c(1, 3, 2))
+    args$x_new <- reticulate::np_array(xb_arr, dtype = "float64")
+  }
+  receipt <- do.call(live$ingest, args)
+  list(
+    n.rows = as.integer(receipt$n_rows),
+    routed.subsets = as.integer(unlist(receipt$routed_subsets)),
+    dirty.subsets = as.integer(unlist(receipt$dirty_subsets)),
+    dirty.group.frac = receipt$dirty_group_frac,
+    # the generation STILL being served — ingest never republishes
+    generation = as.integer(receipt$generation)
+  )
+}
+
+smk.refit <- function(gen.dir, full = FALSE, seed = 1L) {
+  live <- .smk.live.get(gen.dir)
+  jax <- reticulate::import("jax")
+  report <- live$refit(
+    jax$random$key(as.integer(seed)), full = isTRUE(full)
+  )
+  list(
+    generation = if (is.null(report$generation)) NULL else
+      as.integer(report$generation),
+    refit.subsets = as.integer(unlist(report$refit_subsets)),
+    reused.subsets = as.integer(unlist(report$reused_subsets)),
+    dirty.group.frac = report$dirty_group_frac,
+    refit.wall.s = report$refit_wall_s,
+    # full-fit wall over this dirty-only wall, same MCMC schedule on
+    # both sides (matched convergence floor); NULL on a full refit
+    refit.speedup = report$refit_speedup,
+    rhat.max = report$param_rhat_max,
+    skipped = isTRUE(report$skipped)
+  )
+}

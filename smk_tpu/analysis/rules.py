@@ -1,4 +1,4 @@
-"""smklint rules SMK101–SMK117 — the repo's JAX invariants, each one
+"""smklint rules SMK101–SMK119 — the repo's JAX invariants, each one
 traceable to the PR that established it (see analysis/RULES.md).
 
 All rules are pure-AST (no jax import). Shared machinery:
@@ -2191,6 +2191,177 @@ class ScheduleDisciplineRule(Rule):
                     yield self.finding(module, node, ctor_msg)
 
 
+# ---------------------------------------------------------------------------
+# SMK119 — generation-publication discipline
+# ---------------------------------------------------------------------------
+
+# The ONLY in-tree modules that may PUBLISH a generation — commit a
+# manifest/generation file onto its live path by atomic rename.
+# serve/artifact.py owns serving-artifact generations
+# (commit_generation, ISSUE 19); parallel/checkpoint.py owns the v8
+# distributed-checkpoint generation manifest (ISSUE 13). Publication
+# anywhere else forks the commit protocol: a second writer can
+# publish a generation no rollback scan knows about, torn-publish
+# recovery (orphan overwrite at the deterministic bundle name) stops
+# being exhaustive, and the SMK113 atomic-write blessing no longer
+# implies crash safety — the rename is atomic but the PROTOCOL isn't.
+_PUBLICATION_MODULES = (
+    "smk_tpu/serve/artifact",
+    "smk_tpu/parallel/checkpoint",
+)
+
+# a rename call is a PUBLICATION (not a generic temp-file commit,
+# which SMK113 already disciplines) when manifest/generation naming
+# reaches it — in the call's own arguments or anywhere in the
+# enclosing function's non-docstring string constants
+_PUBLICATION_MARKERS = ("manifest", "generation")
+
+# attribute-chain roots whose .replace/.rename members are NOT
+# filesystem renames (dataclasses.replace, np/str munging)
+_NON_RENAME_ROOTS = {
+    "dataclasses", "np", "numpy", "jnp", "jax", "re", "string",
+}
+
+
+class GenerationPublicationRule(Rule):
+    id = "SMK119"
+    name = "generation-publication-discipline"
+    doc = (
+        "generation publication — an atomic rename (os.replace/"
+        "os.rename or the Path method spelling) whose call arguments "
+        "or enclosing function mention manifest/generation naming — "
+        "may only live in serve/artifact.py (commit_generation) or "
+        "parallel/checkpoint.py (the v8 distributed manifest). A "
+        "second publisher forks the two-phase commit protocol: its "
+        "generations are invisible to rollback/orphan scans, so a "
+        "crash can leave a committed-looking manifest the recovery "
+        "path never audits. Route new publication through "
+        "serve.artifact.publish_generation / the checkpoint "
+        "committer instead."
+    )
+
+    def applies(self, module):
+        norm = module.norm_path()
+        if "smk_tpu/" not in norm:
+            return False
+        return not any(z in norm for z in _PUBLICATION_MODULES)
+
+    @staticmethod
+    def _rename_aliases(tree) -> Set[str]:
+        """Local names bound to os.replace/os.rename by from-import
+        (the same alias coverage SMK110/111/113 grew)."""
+        out: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                if node.module == "os" and node.level == 0:
+                    for a in node.names:
+                        if a.name in ("replace", "rename"):
+                            out.add(a.asname or a.name)
+        return out
+
+    @staticmethod
+    def _is_rename_call(node: ast.Call, aliases: Set[str]) -> bool:
+        chain = attr_chain(node.func)
+        if not chain:
+            return False
+        if chain[-2:] in (("os", "replace"), ("os", "rename")):
+            return True
+        if len(chain) == 1 and chain[0] in aliases:
+            return True
+        # the Path method spelling: p.replace(target) / p.rename(t) —
+        # exclude roots that are never filesystem handles
+        if (
+            len(chain) == 2
+            and chain[-1] in ("replace", "rename")
+            and chain[0] not in _NON_RENAME_ROOTS
+            and chain[0] != "os"
+        ):
+            return True
+        return False
+
+    @staticmethod
+    def _marker_strings(node: ast.AST, *, skip_docstrings: bool) -> bool:
+        """Does ``node``'s subtree contain a string constant naming a
+        manifest/generation? Docstrings are skipped when scanning a
+        whole function — prose ABOUT generations is not publication."""
+        doc_nodes = set()
+        if skip_docstrings:
+            for sub in ast.walk(node):
+                if isinstance(
+                    sub,
+                    (ast.FunctionDef, ast.AsyncFunctionDef,
+                     ast.ClassDef, ast.Module),
+                ):
+                    body = getattr(sub, "body", [])
+                    if (
+                        body
+                        and isinstance(body[0], ast.Expr)
+                        and isinstance(body[0].value, ast.Constant)
+                        and isinstance(body[0].value.value, str)
+                    ):
+                        doc_nodes.add(body[0].value)
+        for sub in ast.walk(node):
+            if sub in doc_nodes:
+                continue
+            if isinstance(sub, ast.Constant) and isinstance(
+                sub.value, str
+            ):
+                low = sub.value.lower()
+                if any(m in low for m in _PUBLICATION_MARKERS):
+                    return True
+        return False
+
+    def check(self, module, ctx):
+        aliases = self._rename_aliases(module.tree)
+        idx = _FuncIndex()
+        idx.visit(module.tree)
+
+        def enclosing(node):
+            for fn in idx.funcs:
+                if isinstance(
+                    fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    for sub in ast.walk(fn):
+                        if sub is node:
+                            return fn
+            return None
+
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not self._is_rename_call(node, aliases):
+                continue
+            args_subtree = ast.Module(
+                body=[
+                    ast.Expr(value=a)
+                    for a in list(node.args)
+                    + [kw.value for kw in node.keywords]
+                ],
+                type_ignores=[],
+            )
+            touched = self._marker_strings(
+                args_subtree, skip_docstrings=False
+            )
+            if not touched:
+                fn = enclosing(node)
+                if fn is not None:
+                    touched = self._marker_strings(
+                        fn, skip_docstrings=True
+                    )
+            if not touched:
+                continue
+            yield self.finding(
+                module, node,
+                "atomic rename publishing a manifest/generation "
+                "outside serve/artifact.py + parallel/checkpoint.py "
+                "— a second generation publisher forks the two-phase "
+                "commit protocol (its generations are invisible to "
+                "rollback/orphan recovery); route publication "
+                "through serve.artifact.publish_generation or the "
+                "distributed-checkpoint committer",
+            )
+
+
 ALL_RULES = [
     BatchingRuleRule(),
     HostNondeterminismRule(),
@@ -2210,4 +2381,5 @@ ALL_RULES = [
     BoundedCoalesceWaitRule(),
     DeviceLayoutRule(),
     ScheduleDisciplineRule(),
+    GenerationPublicationRule(),
 ]

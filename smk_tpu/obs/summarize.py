@@ -209,6 +209,41 @@ def serve_block(run: Dict[str, Any]) -> Dict[str, Any]:
     }
 
 
+def ingest_block(run: Dict[str, Any]) -> Dict[str, Any]:
+    """The live-fleet view of a run log (ISSUE 19): streaming-ingest
+    routing, dirty-group refit scheduling and the generation
+    publication/rollover timeline, re-aggregated from the
+    ``ingest_routed`` / ``refit_scheduled`` / ``generation_published``
+    / ``generation_swap`` events the LiveFit loop, artifact publisher
+    and engine/fleet emit. All zeros/None on a plain fit or serve
+    log."""
+    routed = [e["attrs"] for e in _events_named(run, "ingest_routed")]
+    refits = [
+        e["attrs"] for e in _events_named(run, "refit_scheduled")
+    ]
+    published = [
+        e["attrs"] for e in _events_named(run, "generation_published")
+    ]
+    swaps = [e["attrs"] for e in _events_named(run, "generation_swap")]
+    return {
+        "n_ingest_batches": len(routed),
+        "rows_ingested": sum(int(r.get("n_rows", 0)) for r in routed),
+        "n_refits": len(refits),
+        "refit_subsets_total": sum(
+            int(r.get("n_refit", 0)) for r in refits
+        ),
+        "reused_subsets_total": sum(
+            int(r.get("n_reused", 0)) for r in refits
+        ),
+        "n_generations_published": len(published),
+        "last_generation": (
+            published[-1].get("generation") if published else None
+        ),
+        "n_generation_swaps": len(swaps),
+        "last_swap": swaps[-1] if swaps else None,
+    }
+
+
 def summarize(path: str) -> Dict[str, Any]:
     """The full machine-readable summary of one run log."""
     run = load_run(path)
@@ -333,6 +368,9 @@ def summarize(path: str) -> Dict[str, Any]:
         # ISSUE 16: the serving-side view — coalesced-batch
         # occupancy, held-time histogram, shed counters
         "serve": serve_block(run),
+        # ISSUE 19: the live-fleet loop — ingest routing, dirty-group
+        # refit scheduling, generation publication and rollover
+        "ingest": ingest_block(run),
         "counters": (run["end"] or {}).get("counters", {}),
     }
 
@@ -471,4 +509,17 @@ def main(argv: List[str]) -> int:
             )
         if sv["sheds"]:
             print(f"  admission counters: {sv['sheds']}")
+    ig = summary["ingest"]
+    if ig["n_ingest_batches"] or ig["n_generations_published"] or ig[
+        "n_generation_swaps"
+    ]:
+        print(
+            f"\ningest: {ig['n_ingest_batches']} batch(es), "
+            f"{ig['rows_ingested']} row(s); {ig['n_refits']} refit(s) "
+            f"({ig['refit_subsets_total']} refit / "
+            f"{ig['reused_subsets_total']} reused subsets); "
+            f"{ig['n_generations_published']} generation(s) published "
+            f"(last {ig['last_generation']}), "
+            f"{ig['n_generation_swaps']} swap(s)"
+        )
     return 0

@@ -377,6 +377,47 @@ def ragged_mesh_entry_partition(part: PaddedPartition, entry) -> tuple:
     return stacked, ids
 
 
+# quantization depth of the Morton curve — 16 bits per dimension,
+# shared by the partitioner and the ingest router (ISSUE 19): routing
+# a NEW observation must reproduce the partition-time code arithmetic
+# exactly or a point lands in the wrong subset silently
+MORTON_BITS = 16
+
+
+def morton_codes(
+    coords,
+    *,
+    lo,
+    span,
+    bits: int = MORTON_BITS,
+) -> np.ndarray:
+    """Interleaved-bit Morton (Z-order) codes of ``coords`` under a
+    FIXED quantization frame ``(lo, span, bits)`` — the one code
+    arithmetic shared by :func:`coherent_assignments` (which derives
+    the frame from the data) and the serve-side ingest router (which
+    FREEZES the fit-time frame so new observations quantize exactly
+    as the partition did). Out-of-frame coordinates clip onto the
+    frame boundary: the nearest edge cell is the nearest subset under
+    the Z-order metric, and a clip can never wrap into a wrong code
+    the way a negative float→uint64 cast would."""
+    c = np.asarray(coords, np.float64)
+    lo = np.asarray(lo, np.float64)
+    span = np.asarray(span, np.float64)
+    n, d = c.shape
+    frac = np.clip((c - lo) / span, 0.0, 1.0)
+    quant = np.minimum(
+        (frac * (2**bits - 1)).astype(np.uint64),
+        2**bits - 1,
+    )
+    code = np.zeros(n, np.uint64)
+    for b in range(bits):
+        for j in range(d):
+            code |= ((quant[:, j] >> np.uint64(b)) & np.uint64(1)) << (
+                np.uint64(b * d + j)
+            )
+    return code
+
+
 def coherent_assignments(
     coords,
     n_subsets: int,
@@ -422,21 +463,12 @@ def coherent_assignments(
         raise ValueError(
             f"n_subsets must be in [1, n={n}], got {k}"
         )
-    bits = 16
     lo = c.min(axis=0)
     span = c.max(axis=0) - lo
     span = np.where(span > 0, span, 1.0)
-    quant = np.minimum(
-        ((c - lo) / span * (2**bits - 1)).astype(np.uint64),
-        2**bits - 1,
-    )
-    code = np.zeros(n, np.uint64)
-    for b in range(bits):
-        for j in range(d):
-            code |= ((quant[:, j] >> np.uint64(b)) & np.uint64(1)) << (
-                np.uint64(b * d + j)
-            )
+    code = morton_codes(c, lo=lo, span=span)
     order = np.argsort(code, kind="stable")
+    bits = MORTON_BITS
     if k == 1:
         return [order]
     if cell_bits is None:

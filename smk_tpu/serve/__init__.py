@@ -8,13 +8,34 @@ ISSUE 16 adds cross-request coalescing (serve/coalesce.py — pack
 concurrent requests into one padded ladder dispatch within a
 deadline-aware window) and shared-store replica fleets
 (serve/fleet.py — N engines behind a shedding front door, zero
-compiles per replica on a warm store)."""
+compiles per replica on a warm store).
+
+ISSUE 19 closes the fit→serve→ingest→re-fit loop: serve/ingest.py
+routes new observations to their Morton subsets, re-fits only the
+dirty ones warm-started from carried state, and publishes each
+result as a two-phase-committed GENERATION (serve/artifact.py) the
+engine/fleet hot-swap onto with zero dropped requests."""
 
 from smk_tpu.serve.artifact import (
     ArtifactError,
     FitArtifact,
+    GenerationError,
+    commit_generation,
+    current_generation,
+    generation_artifact_name,
+    land_generation,
     load_artifact,
+    load_current_generation,
+    orphan_generations,
+    publish_generation,
     save_artifact,
+)
+from smk_tpu.serve.ingest import (
+    IngestError,
+    IngestReceipt,
+    LiveFit,
+    MortonRouter,
+    RefitReport,
 )
 from smk_tpu.serve.coalesce import RequestCoalescer
 from smk_tpu.serve.deadline import (
@@ -23,6 +44,7 @@ from smk_tpu.serve.deadline import (
     run_under_deadline,
 )
 from smk_tpu.serve.engine import (
+    ArtifactSwapError,
     EngineDrainingError,
     PredictionEngine,
     PredictResponse,
@@ -33,11 +55,25 @@ from smk_tpu.serve.fleet import FleetSaturatedError, ReplicaFleet
 __all__ = [
     "ArtifactError",
     "FitArtifact",
+    "GenerationError",
+    "commit_generation",
+    "current_generation",
+    "generation_artifact_name",
+    "land_generation",
     "load_artifact",
+    "load_current_generation",
+    "orphan_generations",
+    "publish_generation",
     "save_artifact",
+    "IngestError",
+    "IngestReceipt",
+    "LiveFit",
+    "MortonRouter",
+    "RefitReport",
     "DeadlineBudget",
     "RequestTimeoutError",
     "run_under_deadline",
+    "ArtifactSwapError",
     "EngineDrainingError",
     "PredictionEngine",
     "PredictResponse",

@@ -17,19 +17,41 @@ write-to-temp + atomic rename, stamped with a CRC32 over every
 payload array AND the format version — a truncated or bit-flipped
 artifact raises a typed :class:`ArtifactError` at load, never a
 silent mis-serve.
+
+**Generation-committed publication** (ISSUE 19): a live fleet
+re-fits and republishes, so artifacts gain generations. A
+generation directory holds numbered bundles
+(``artifact.g000000.npz``, ...) plus ONE manifest naming the
+current generation, published with the PR 12 two-phase commit
+discipline: :func:`land_generation` writes the (already-atomic)
+bundle at its generation name, then :func:`commit_generation`
+atomically renames a temp manifest over the live one. A crash in
+ANY window — bundle half-written, bundle landed but manifest not
+renamed — leaves the previous generation's manifest intact and
+loadable; the orphaned bundle is overwritten by the next publish
+at the same deterministic name. This module (with
+parallel/checkpoint.py) is the ONE place manifest publication may
+live — smklint SMK119 flags a manifest rename anywhere else.
 """
 
 from __future__ import annotations
 
+import json
 import os
+import time
 import zlib
-from typing import NamedTuple
+from typing import NamedTuple, Optional, Tuple
 
 import numpy as np
 
 from smk_tpu.utils.checkpoint import _atomic_savez
 
 ARTIFACT_VERSION = 1
+
+# the one live pointer of a generation directory — naming the current
+# artifact bundle; replaced atomically by commit_generation and read
+# by every replica's load_current_generation
+GENERATION_MANIFEST = "MANIFEST.json"
 
 # EVERY stored field is covered by the CRC, in the exact order
 # hashed — the scalars and strings included, because a flipped byte
@@ -239,3 +261,176 @@ def load_artifact(path: str) -> FitArtifact:
         jitter_per_m=float(arrays["jitter_per_m"][0]),
         config_digest=arrays["config_digest"].tobytes().decode(),
     )
+
+
+# ---------------------------------------------------------------------------
+# Generation-committed publication (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+class GenerationError(ArtifactError):
+    """A generation directory cannot be served from: no manifest has
+    ever been committed, or the committed manifest is unreadable /
+    names a bundle that fails :func:`load_artifact`. Typed so a
+    replica can distinguish "nothing published yet" from an engine
+    fault."""
+
+
+def generation_artifact_name(generation: int) -> str:
+    """The deterministic bundle name of a generation — deterministic
+    so a torn publish's orphan is simply overwritten by the retry at
+    the same name, never accumulated under a fresh one."""
+    g = int(generation)
+    if g < 0:
+        raise ValueError(f"generation must be >= 0, got {g}")
+    return f"artifact.g{g:06d}.npz"
+
+
+def current_generation(gen_dir: str) -> Optional[dict]:
+    """The committed manifest of a generation directory, or ``None``
+    when no generation has ever been committed. A manifest that
+    EXISTS but cannot be parsed is a loud :class:`GenerationError`
+    (an atomic rename never leaves a half-written manifest, so a
+    corrupt one is real damage, not a crash window)."""
+    path = os.path.join(gen_dir, GENERATION_MANIFEST)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except Exception as e:
+        raise GenerationError(
+            f"generation manifest {path!r} is unreadable ({e!r}) — "
+            "commits are atomic renames, so this is corruption, not "
+            "a crash window; recommit with publish_generation"
+        ) from e
+    if "generation" not in manifest or "artifact" not in manifest:
+        raise GenerationError(
+            f"generation manifest {path!r} is missing its "
+            "generation/artifact fields — not a commit_generation "
+            "manifest"
+        )
+    return manifest
+
+
+def land_generation(
+    gen_dir: str,
+    result,
+    coords_test,
+    *,
+    config=None,
+    cache=None,
+    generation: Optional[int] = None,
+) -> Tuple[int, str]:
+    """Phase ONE of a publish: write the bundle at its generation
+    name (itself atomic + CRC'd via :func:`save_artifact`) WITHOUT
+    touching the manifest. Returns ``(generation, bundle_path)``.
+    ``generation`` defaults to committed + 1 (0 on a fresh
+    directory). A crash after this call leaves the previous
+    generation's manifest — and therefore every replica — untouched.
+    """
+    os.makedirs(gen_dir, exist_ok=True)
+    if generation is None:
+        cur = current_generation(gen_dir)
+        generation = 0 if cur is None else int(cur["generation"]) + 1
+    path = os.path.join(
+        gen_dir, generation_artifact_name(generation)
+    )
+    save_artifact(
+        path, result, coords_test, config=config, cache=cache
+    )
+    return int(generation), path
+
+
+def commit_generation(
+    gen_dir: str, generation: int, *, meta: Optional[dict] = None
+) -> dict:
+    """Phase TWO of a publish: atomically rename a temp manifest over
+    the live one, making ``generation`` the current generation in one
+    indivisible step. The bundle must already be landed (typed error
+    otherwise — committing a pointer to nothing would tear every
+    subsequent load). Returns the committed manifest dict."""
+    name = generation_artifact_name(generation)
+    bundle = os.path.join(gen_dir, name)
+    if not os.path.exists(bundle):
+        raise GenerationError(
+            f"cannot commit generation {int(generation)}: bundle "
+            f"{bundle!r} is not landed — call land_generation first"
+        )
+    manifest = {
+        "generation": int(generation),
+        "artifact": name,
+        "format": ARTIFACT_VERSION,
+        "published_at": time.time(),  # smklint: disable=SMK110 -- wall-clock PROVENANCE stamp in the durable manifest (operators correlate generations against external logs), not a duration measurement; monotonic() has no epoch
+    }
+    if meta:
+        manifest.update(meta)
+    path = os.path.join(gen_dir, GENERATION_MANIFEST)
+    tmp = path + f".tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return manifest
+
+
+def publish_generation(
+    gen_dir: str,
+    result,
+    coords_test,
+    *,
+    config=None,
+    cache=None,
+    generation: Optional[int] = None,
+    meta: Optional[dict] = None,
+) -> dict:
+    """Two-phase generation publish: land the bundle, then commit the
+    manifest. Returns the committed manifest. Crash-safe in every
+    window (see module docstring)."""
+    gen, _ = land_generation(
+        gen_dir, result, coords_test,
+        config=config, cache=cache, generation=generation,
+    )
+    return commit_generation(gen_dir, gen, meta=meta)
+
+
+def load_current_generation(
+    gen_dir: str,
+) -> Tuple[FitArtifact, dict]:
+    """Load the committed generation's artifact: ``(artifact,
+    manifest)``. Typed :class:`GenerationError` when nothing was ever
+    committed; a committed manifest naming an unloadable bundle
+    re-raises the underlying :class:`ArtifactError` (that is real
+    corruption of a PUBLISHED bundle, which the commit discipline
+    cannot cause — only external damage can)."""
+    manifest = current_generation(gen_dir)
+    if manifest is None:
+        raise GenerationError(
+            f"no generation committed in {gen_dir!r} — publish one "
+            "with publish_generation"
+        )
+    art = load_artifact(os.path.join(gen_dir, manifest["artifact"]))
+    return art, manifest
+
+
+def orphan_generations(gen_dir: str) -> Tuple[int, ...]:
+    """Landed-but-never-committed generation numbers: bundles newer
+    than the committed generation (torn-publish residue, or a publish
+    in flight). Diagnostic only — orphans are inert (no manifest
+    points at them) and the next publish overwrites the lowest one at
+    its deterministic name."""
+    cur = current_generation(gen_dir)
+    committed = -1 if cur is None else int(cur["generation"])
+    out = []
+    if not os.path.isdir(gen_dir):
+        return ()
+    for name in os.listdir(gen_dir):
+        if name.startswith("artifact.g") and name.endswith(".npz"):
+            try:
+                g = int(name[len("artifact.g"):-len(".npz")])
+            except ValueError:
+                continue
+            if g > committed:
+                out.append(g)
+    return tuple(sorted(out))

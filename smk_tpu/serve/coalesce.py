@@ -236,6 +236,10 @@ class RequestCoalescer:
                 if log is not None else contextlib.nullcontext()
             )
             pq_parts, ps_parts, mask_parts, used = [], [], [], []
+            # capture ONE generation for the whole batch: a hot-swap
+            # landing mid-flush must not tear a coalesced batch across
+            # artifacts (every member sees the same generation)
+            gen = eng._gen
             with span:
                 for lo, hi, u in slice_plan(total, eng.buckets):
                     if dbudget.expired():
@@ -248,6 +252,7 @@ class RequestCoalescer:
                         all_c[lo:hi], all_x[lo:hi],
                         all_rs[lo:hi], all_ri[lo:hi],
                         u, f"coalesce{bid}/bucket{u}", dbudget,
+                        gen,
                     )
                     pq_parts.append(pqp)
                     mask_parts.append(maskp)

@@ -51,6 +51,20 @@ per-request results are bit-identical within the coalescing mode.
 ``coalesce_window_ms = 0`` (the default) is byte-identical to the
 pre-coalescer engine: same code path, same ``serve_predict`` program
 keys, zero extra programs built.
+
+**Zero-downtime generation rollover** (ISSUE 19): the engine's
+artifact + device constants live in ONE immutable
+:class:`_Generation` snapshot. Every request captures the snapshot
+ONCE at admission and threads it through its dispatches, so a
+response is always EITHER-generation-consistent — never a torn mix
+of two artifacts' constants — and :meth:`PredictionEngine.
+swap_artifact` publishes a new generation as a single reference
+assignment: in-flight requests finish on the old snapshot (its
+device arrays stay alive through their references), new requests
+see the new one, zero requests dropped or blocked. Swapping a
+same-config re-fit artifact resolves the SAME program keys (the
+geometry and ``serve_digest`` ride the key; draws don't), so a
+rollover compiles nothing.
 """
 
 from __future__ import annotations
@@ -79,6 +93,26 @@ DEFAULT_DEGRADED_THRESHOLD = 3
 # generous deadline for the warm-up throwaway dispatch — warm() pays
 # compile by design, but even it must be a bounded wait (SMK111)
 _WARM_DEADLINE_S = 600.0
+
+
+class ArtifactSwapError(RuntimeError):
+    """A generation swap was rejected: the incoming artifact's
+    geometry (draw count, anchor grid, q/p, coordinate dimension,
+    dtype) differs from the serving generation's. Hot-swap is a
+    same-geometry contract — the ladder programs are lowered against
+    those shapes; a different geometry needs a NEW engine, not a
+    swap."""
+
+
+class _Generation(NamedTuple):
+    """One immutable serving generation: the artifact and its
+    device-committed constants. Requests capture a generation at
+    admission and never re-read engine state mid-flight — the
+    never-torn-response invariant."""
+
+    gen_id: int
+    artifact: "FitArtifact"
+    const: tuple
 
 
 class QueueFullError(RuntimeError):
@@ -192,7 +226,6 @@ class PredictionEngine:
             raise TypeError(
                 "artifact must be a FitArtifact or a path to one"
             )
-        self.artifact = artifact
         bs = tuple(sorted({int(b) for b in buckets}))
         if not bs or bs[0] <= 0:
             raise ValueError(
@@ -226,6 +259,8 @@ class PredictionEngine:
             # slice) — the coalescing amortization signal: under
             # coalescing this runs STRICTLY below the request count
             "dispatches": 0,
+            # zero-downtime generation rollovers completed (ISSUE 19)
+            "generation_swaps": 0,
         }
         if pipeline_stats is None:
             from smk_tpu.utils.tracing import ChunkPipelineStats
@@ -251,21 +286,11 @@ class PredictionEngine:
                     "config_digest": artifact.config_digest,
                 },
             )
-        # device-committed constants, put once — requests only ship
-        # the (padded) query slice and a seed
-        dt = artifact.sample_w.dtype
-        t, q, p = artifact.n_anchor, artifact.q, artifact.p
-        s = artifact.n_draws
-        self._dtype = dt
-        self._const = tuple(
-            jax.device_put(np.asarray(a, dt)) for a in (
-                artifact.chol_tt,
-                artifact.sample_w.reshape(s, t, q),
-                artifact.sample_par[:, : q * p].reshape(s, q, p),
-                artifact.phi,
-                artifact.coords_test,
-            )
-        )
+        # device-committed constants, put once per GENERATION —
+        # requests only ship the (padded) query slice and a seed, and
+        # capture the whole generation snapshot at admission
+        self._dtype = artifact.sample_w.dtype
+        self._gen = self._make_generation(artifact, 0)
         self.coalesce_window_ms = float(coalesce_window_ms)
         if self.coalesce_window_ms < 0:
             raise ValueError(
@@ -282,30 +307,121 @@ class PredictionEngine:
         if warm:
             self.warm()
 
+    # -- generations (ISSUE 19) ------------------------------------
+
+    def _make_generation(self, artifact, gen_id: int) -> _Generation:
+        import jax
+
+        dt = self._dtype
+        t, q, p = artifact.n_anchor, artifact.q, artifact.p
+        s = artifact.n_draws
+        const = tuple(
+            jax.device_put(np.asarray(a, dt)) for a in (
+                artifact.chol_tt,
+                artifact.sample_w.reshape(s, t, q),
+                artifact.sample_par[:, : q * p].reshape(s, q, p),
+                artifact.phi,
+                artifact.coords_test,
+            )
+        )
+        return _Generation(
+            gen_id=int(gen_id), artifact=artifact, const=const
+        )
+
+    @property
+    def artifact(self) -> FitArtifact:
+        return self._gen.artifact
+
+    @property
+    def _const(self) -> tuple:
+        return self._gen.const
+
+    @property
+    def generation(self) -> int:
+        return self._gen.gen_id
+
+    def swap_artifact(self, artifact, *, generation=None) -> dict:
+        """Hot-swap onto a new generation with zero dropped requests:
+        build the new snapshot (device puts + program warm-up OFF the
+        request path), then publish it as one reference assignment.
+        In-flight requests complete on the snapshot they captured at
+        admission; no request ever observes a half-swapped engine.
+        Same-geometry only (typed :class:`ArtifactSwapError`
+        otherwise); a same-config re-fit resolves identical program
+        keys, so the swap compiles nothing. Returns ``{"generation",
+        "programs"}``."""
+        if isinstance(artifact, (str, bytes)) or hasattr(
+            artifact, "__fspath__"
+        ):
+            artifact = load_artifact(artifact)
+        if not isinstance(artifact, FitArtifact):
+            raise TypeError(
+                "artifact must be a FitArtifact or a path to one"
+            )
+        old = self._gen
+        cur = old.artifact
+        geom = lambda a: (  # noqa: E731 - local shape tuple
+            a.n_draws, a.n_anchor, a.q, a.p, a.coord_dim,
+            str(a.sample_w.dtype),
+        )
+        if geom(artifact) != geom(cur):
+            raise ArtifactSwapError(
+                "generation swap rejected: artifact geometry "
+                f"{geom(artifact)} != serving geometry {geom(cur)} — "
+                "the ladder programs are lowered against the serving "
+                "shapes; build a new engine for a new geometry"
+            )
+        gen_id = (
+            int(generation) if generation is not None
+            else old.gen_id + 1
+        )
+        new = self._make_generation(artifact, gen_id)
+        if self._warm and artifact.serve_digest() != cur.serve_digest():
+            # config-digest change (cov_model/link/jitter): new keys —
+            # warm them off the request path so the first post-swap
+            # request touches nothing cold. The common rollover (same
+            # config, fresh draws) has identical keys and skips this.
+            for u in self.buckets:
+                self._programs(u, a=artifact)
+                if self._coalescer is not None:
+                    self._programs_rows(u, a=artifact)
+        self._gen = new
+        self._count("generation_swaps")
+        if self.run_log is not None:
+            self.run_log.event(
+                "generation_swap",
+                from_generation=old.gen_id, to_generation=gen_id,
+                config_digest=artifact.config_digest,
+            )
+        return {
+            "generation": gen_id,
+            "programs": self.program_summary(),
+        }
+
     # -- program acquisition (L1/L2, ISSUE 8) ----------------------
 
-    def _predict_key(self, u: int) -> tuple:
-        a = self.artifact
+    def _predict_key(self, u: int, a=None) -> tuple:
+        a = a if a is not None else self.artifact
         return (
             "serve_predict", int(u), a.n_draws, a.n_anchor, a.q,
             a.p, a.coord_dim, str(self._dtype), a.cov_model, a.link,
             a.serve_digest(),
         )
 
-    def _guard_key(self, u: int) -> tuple:
-        a = self.artifact
+    def _guard_key(self, u: int, a=None) -> tuple:
+        a = a if a is not None else self.artifact
         return (
             "serve_guard", int(u), a.n_draws, a.q,
             str(self._dtype), a.serve_digest(),
         )
 
-    def _build_predict(self, u: int):
+    def _build_predict(self, u: int, a=None):
         import jax
 
         from smk_tpu.api import _krige_predict_core
         from smk_tpu.ops.quantiles import credible_summary
 
-        a = self.artifact
+        a = a if a is not None else self.artifact
         s, q = a.n_draws, a.q
         cov_model, link = a.cov_model, a.link
         var_floor = a.var_floor()
@@ -338,10 +454,10 @@ class PredictionEngine:
 
         return jax.jit(fn)
 
-    def _lower_args(self, u: int):
+    def _lower_args(self, u: int, a=None):
         import jax
 
-        a = self.artifact
+        a = a if a is not None else self.artifact
         dt = self._dtype
         s, t, q, p, d = (
             a.n_draws, a.n_anchor, a.q, a.p, a.coord_dim,
@@ -353,24 +469,29 @@ class PredictionEngine:
             sd((u, q, p), dt), sd((), np.uint32),
         )
 
-    def _programs(self, u: int):
+    def _programs(self, u: int, a=None):
         """(predict, guard) compiled programs for bucket ``u`` via
         the L1 → L2 → AOT-build lookup (compile/programs) — warm
         engines resolve from L1, fresh processes on a warm store
         deserialize from L2, and only a cold store-less engine pays
-        compile (off the request path when ``warm=True``)."""
+        compile (off the request path when ``warm=True``). ``a``
+        selects the generation's artifact (default: current) — the
+        keys carry its geometry + serve digest, so two generations of
+        one fit config share every program."""
         import jax
 
         from smk_tpu.compile.programs import get_program
 
+        a = a if a is not None else self.artifact
         pred = get_program(
-            self, self._predict_key(u), lambda: self._build_predict(u),
-            store=self._store, lower_args=self._lower_args(u),
+            self, self._predict_key(u, a),
+            lambda: self._build_predict(u, a),
+            store=self._store, lower_args=self._lower_args(u, a),
             stats=self.pstats,
         )
-        a = self.artifact
         guard = get_program(
-            self, self._guard_key(u), lambda: self._build_guard(u),
+            self, self._guard_key(u, a),
+            lambda: self._build_guard(u),
             store=self._store,
             lower_args=(jax.ShapeDtypeStruct(
                 (a.n_draws, u, a.q), self._dtype
@@ -381,21 +502,21 @@ class PredictionEngine:
 
     # -- packing-invariant row-seed variant (ISSUE 16) ---------------
 
-    def _predict_rows_key(self, u: int) -> tuple:
-        a = self.artifact
+    def _predict_rows_key(self, u: int, a=None) -> tuple:
+        a = a if a is not None else self.artifact
         return (
             "serve_predict_rs", int(u), a.n_draws, a.n_anchor, a.q,
             a.p, a.coord_dim, str(self._dtype), a.cov_model, a.link,
             a.serve_digest(),
         )
 
-    def _build_predict_rows(self, u: int):
+    def _build_predict_rows(self, u: int, a=None):
         import jax
 
         from smk_tpu.api import _krige_predict_core
         from smk_tpu.ops.quantiles import credible_summary
 
-        a = self.artifact
+        a = a if a is not None else self.artifact
         s, q = a.n_draws, a.q
         cov_model, link = a.cov_model, a.link
         var_floor = a.var_floor()
@@ -427,17 +548,17 @@ class PredictionEngine:
 
         return jax.jit(fn)
 
-    def _lower_args_rows(self, u: int):
+    def _lower_args_rows(self, u: int, a=None):
         import jax
 
         sd = jax.ShapeDtypeStruct
         # same operands as the scalar-seed program, with the trailing
         # () seed replaced by per-row (seed, index) vectors
-        return self._lower_args(u)[:-1] + (
+        return self._lower_args(u, a)[:-1] + (
             sd((u,), np.uint32), sd((u,), np.int32),
         )
 
-    def _programs_rows(self, u: int):
+    def _programs_rows(self, u: int, a=None):
         """(predict, guard) for bucket ``u`` in the packing-invariant
         row-seed variant. The guard is the SAME program as the
         per-request path (its input shape (S, u, q) is unchanged), so
@@ -447,15 +568,16 @@ class PredictionEngine:
 
         from smk_tpu.compile.programs import get_program
 
+        a = a if a is not None else self.artifact
         pred = get_program(
-            self, self._predict_rows_key(u),
-            lambda: self._build_predict_rows(u),
-            store=self._store, lower_args=self._lower_args_rows(u),
+            self, self._predict_rows_key(u, a),
+            lambda: self._build_predict_rows(u, a),
+            store=self._store, lower_args=self._lower_args_rows(u, a),
             stats=self.pstats,
         )
-        a = self.artifact
         guard = get_program(
-            self, self._guard_key(u), lambda: self._build_guard(u),
+            self, self._guard_key(u, a),
+            lambda: self._build_guard(u),
             store=self._store,
             lower_args=(jax.ShapeDtypeStruct(
                 (a.n_draws, u, a.q), self._dtype
@@ -591,7 +713,11 @@ class PredictionEngine:
             raise EngineDrainingError(
                 "engine is draining — no new requests"
             )
-        a = self.artifact
+        # capture the serving generation ONCE — the whole request is
+        # served from this snapshot, so a concurrent swap_artifact can
+        # never tear a response across two generations (ISSUE 19)
+        gen = self._gen
+        a = gen.artifact
         cq, xq = validate_query_batch(
             coords_query, x_query, d=a.coord_dim, q=a.q, p=a.p
         )
@@ -642,7 +768,7 @@ class PredictionEngine:
         finally:
             self._queue_sem.release()
         try:
-            return self._serve(cq, xq, rid, int(seed), budget)
+            return self._serve(cq, xq, rid, int(seed), budget, gen)
         except RequestTimeoutError:
             # dispatch/guard overrun: the worker is abandoned (it
             # holds no locks) and the slot frees in the finally — the
@@ -653,9 +779,12 @@ class PredictionEngine:
         finally:
             self._inflight.release()
 
-    def _serve(self, cq, xq, rid, seed, budget) -> PredictResponse:
+    def _serve(
+        self, cq, xq, rid, seed, budget, gen=None
+    ) -> PredictResponse:
         import contextlib
 
+        gen = gen if gen is not None else self._gen
         n = cq.shape[0]
         queued_s = budget.elapsed()
         log = self.run_log
@@ -689,7 +818,7 @@ class PredictionEngine:
                 )
                 with bspan:
                     pqp, psp, maskp = self._dispatch_slice(
-                        sl_c, sl_x, u, rid, seed + lo, budget
+                        sl_c, sl_x, u, rid, seed + lo, budget, gen
                     )
                 pq_parts.append(pqp)
                 mask_parts.append(maskp)
@@ -712,15 +841,21 @@ class PredictionEngine:
             latency_s=budget.elapsed(),
         )
 
-    def _dispatch_slice(self, sl_c, sl_x, u, rid, seed, budget):
+    def _dispatch_slice(
+        self, sl_c, sl_x, u, rid, seed, budget, gen=None
+    ):
         """One micro-batch slice through its bucket: pad → dispatch →
         guard, every device wait under the request deadline. Pad rows
         repeat the slice's first query (guaranteed-finite content —
         they are sliced away before the response and, the composition
         draw being row-independent, arithmetically invisible to real
-        rows)."""
+        rows). ``gen`` is the request's captured generation snapshot
+        — constants and program keys come from IT, never from live
+        engine state (the never-torn invariant)."""
         import contextlib
 
+        gen = gen if gen is not None else self._gen
+        a = gen.artifact
         log = self.run_log
         n_sl = sl_c.shape[0]
         pad = u - n_sl
@@ -731,10 +866,10 @@ class PredictionEngine:
             sl_x = np.concatenate(
                 [sl_x, np.zeros((pad,) + sl_x.shape[1:], sl_x.dtype)]
             )
-        pred, guard = self._programs(u)
+        pred, guard = self._programs(u, a)
         label = f"{rid}/bucket{u}"
-        pkey, gkey = self._predict_key(u), self._guard_key(u)
-        const = self._const
+        pkey, gkey = self._predict_key(u, a), self._guard_key(u, a)
+        const = gen.const
         sl_c = sl_c.astype(self._dtype, copy=False)
         sl_x = sl_x.astype(self._dtype, copy=False)
         seed_arr = np.uint32(seed & 0xFFFFFFFF)
@@ -784,7 +919,7 @@ class PredictionEngine:
         )
 
     def _dispatch_slice_rows(
-        self, sl_c, sl_x, sl_rs, sl_ri, u, label, budget
+        self, sl_c, sl_x, sl_rs, sl_ri, u, label, budget, gen=None
     ):
         """One COALESCED micro-batch slice through its bucket via the
         packing-invariant row-seed program: pad → dispatch → guard,
@@ -792,9 +927,13 @@ class PredictionEngine:
         discipline as :meth:`_dispatch_slice`). Pad rows repeat the
         slice's first entry — coords, seed and index alike —
         guaranteed-finite content that is sliced away before
-        scatter-back."""
+        scatter-back. ``gen`` is the BATCH's captured generation
+        (serve/coalesce captures one snapshot per flush, so every
+        member of a coalesced batch is served from one generation)."""
         import contextlib
 
+        gen = gen if gen is not None else self._gen
+        a = gen.artifact
         log = self.run_log
         n_sl = sl_c.shape[0]
         pad = u - n_sl
@@ -807,9 +946,11 @@ class PredictionEngine:
             )
             sl_rs = np.concatenate([sl_rs, np.repeat(sl_rs[:1], pad)])
             sl_ri = np.concatenate([sl_ri, np.repeat(sl_ri[:1], pad)])
-        pred, guard = self._programs_rows(u)
-        pkey, gkey = self._predict_rows_key(u), self._guard_key(u)
-        const = self._const
+        pred, guard = self._programs_rows(u, a)
+        pkey, gkey = (
+            self._predict_rows_key(u, a), self._guard_key(u, a)
+        )
+        const = gen.const
         sl_c = sl_c.astype(self._dtype, copy=False)
         sl_x = sl_x.astype(self._dtype, copy=False)
         sl_rs = np.ascontiguousarray(sl_rs, dtype=np.uint32)
@@ -867,6 +1008,7 @@ class PredictionEngine:
             out["state"] = self._state
             out["ready"] = self._state == "ready"
             out["warm"] = self._warm
+            out["generation"] = self._gen.gen_id
             out["consecutive_guard_trips"] = self._consecutive_trips
             out["buckets"] = list(self.buckets)
             out["max_queue"] = self.max_queue

@@ -199,6 +199,42 @@ class ReplicaFleet:
             self.n_replicas, self._engines[0].max_queue
         )
 
+    # -- generation rollover ------------------------------------------
+
+    def swap_artifact(self, artifact, *, generation=None) -> dict:
+        """Hot-swap EVERY replica onto a new artifact generation with
+        zero dropped requests. ``artifact`` is a
+        :class:`~smk_tpu.serve.artifact.FitArtifact` or a bundle
+        path; it is loaded ONCE and shared (each engine's swap is a
+        non-blocking snapshot replacement — in-flight requests keep
+        the generation they admitted under). Replica swaps happen in
+        sequence, so mid-rollover the fleet briefly serves from two
+        generations — each response is internally consistent (never
+        torn). Returns ``{"generation", "replicas"}``."""
+        from smk_tpu.serve.artifact import FitArtifact, load_artifact
+
+        if isinstance(artifact, str):
+            artifact = load_artifact(artifact)
+        if not isinstance(artifact, FitArtifact):
+            raise TypeError(
+                "swap_artifact expects a FitArtifact or bundle path, "
+                f"got {type(artifact).__name__}"
+            )
+        out = None
+        for eng in self._engines:
+            out = eng.swap_artifact(artifact, generation=generation)
+        self.artifact = artifact
+        if self.run_log is not None:
+            self.run_log.event(
+                "generation_swap",
+                generation=out["generation"] if out else generation,
+                n_replicas=self.n_replicas,
+            )
+        return {
+            "generation": out["generation"] if out else generation,
+            "replicas": self.n_replicas,
+        }
+
     # -- health / lifecycle -------------------------------------------
 
     def health(self) -> dict:

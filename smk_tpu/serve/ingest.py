@@ -1,0 +1,701 @@
+"""Streaming ingest + incremental dirty-group re-fits: the closed
+fit→serve→ingest→re-fit loop (ISSUE 19, ROADMAP item 2).
+
+SMK's whole premise is that the posterior decomposes over K subsets —
+so a batch of NEW observations should only ever cost the subsets it
+touches. The pieces exist piecewise in this repo; this module closes
+the loop:
+
+- **Routing** (:class:`MortonRouter`): the fit-time Morton
+  quantization frame (``parallel/partition.morton_codes`` — the ONE
+  code arithmetic, shared with ``coherent_assignments``) is FROZEN at
+  the initial fit, so a new observation quantizes exactly as the
+  partition did and lands in the subset whose Z-order run covers its
+  code. Deterministic: same coordinates → same subset, forever.
+- **Dirty-subset re-fits** (:meth:`LiveFit.refit`): only the subsets
+  an ingest touched are re-fit — as their own
+  :class:`~smk_tpu.parallel.partition.PaddedPartition` through the
+  chunked executor (same √2 ladder, so unchanged rungs resolve
+  through the warm program store), warm-started from the previous
+  COMBINED posterior's median betas instead of a cold GLM start. The
+  untouched subsets' quantile grids and kept draws are carried
+  VERBATIM — bit-identical by construction, which is the honest half
+  of the contract: untouched groups are bitwise stable, re-fit groups
+  are statistically fresh (they saw new data; bitwise identity would
+  be a bug).
+- **Generation rollover**: every fit/refit publishes through
+  ``serve/artifact.py``'s two-phase generation commit (land bundle →
+  atomically rename ONE manifest), so a crash mid-publish never tears
+  an artifact a replica might load, and
+  :meth:`PredictionEngine.swap_artifact` hot-swaps replicas onto the
+  new generation with zero dropped requests.
+
+The speedup contract — ``refit_speedup`` = full re-fit wall over
+dirty-only re-fit wall at a MATCHED convergence floor (identical
+per-subset MCMC schedule, so the floor matches by construction) — is
+pinned end-to-end by ``scripts/ingest_probe.py`` (INGEST_r20.jsonl)
+and the ``BENCH_INGEST=1`` rung.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from smk_tpu.serve.artifact import (
+    current_generation,
+    load_current_generation,
+    publish_generation,
+)
+from smk_tpu.utils.tracing import monotonic
+
+
+class IngestError(ValueError):
+    """An ingest/refit request is malformed (shape, dtype,
+    non-finite content, unknown subset) or arrives before the initial
+    fit — typed rejection at the boundary, before any state
+    mutation, same policy as api.validate_query_batch."""
+
+
+class IngestReceipt(NamedTuple):
+    """What one :meth:`LiveFit.ingest` call did: rows appended, which
+    subsets they routed to, the resulting dirty set and its group
+    fraction, and the generation the fleet is STILL serving (ingest
+    never republishes — :meth:`LiveFit.refit` does)."""
+
+    n_rows: int
+    routed_subsets: Tuple[int, ...]
+    dirty_subsets: Tuple[int, ...]
+    dirty_groups: Tuple[int, ...]
+    dirty_group_frac: float
+    generation: Optional[int]
+
+
+class RefitReport(NamedTuple):
+    """What one :meth:`LiveFit.refit` call did. ``refit_speedup`` is
+    the honest perf headline: the most recent FULL re-fit wall over
+    this dirty-only re-fit wall, same per-subset schedule on both
+    sides (matched convergence floor by construction); ``None`` until
+    a full baseline exists or when this refit WAS the full baseline.
+    """
+
+    generation: Optional[int]
+    refit_subsets: Tuple[int, ...]
+    reused_subsets: Tuple[int, ...]
+    dirty_group_frac: float
+    refit_wall_s: float
+    full_fit_wall_s: Optional[float]
+    refit_speedup: Optional[float]
+    param_rhat_max: Optional[float]
+    skipped: bool = False
+
+
+class MortonRouter(NamedTuple):
+    """Frozen fit-time routing: the Morton quantization frame
+    ``(lo, span, bits)`` plus the code at which each subset's Z-order
+    run begins. Routing a new point recomputes its code under the
+    FROZEN frame (out-of-frame points clip onto the boundary — the
+    nearest edge subset) and binary-searches the run boundaries.
+    Pure data, picklable, deterministic."""
+
+    lo: np.ndarray
+    span: np.ndarray
+    bits: int
+    # boundaries[i] = the minimum Morton code of subset i+1's run
+    # (K-1 entries): a code c routes to the number of boundaries <= c
+    boundaries: np.ndarray
+    n_subsets: int
+
+    @classmethod
+    def from_assignments(cls, coords, assignments) -> "MortonRouter":
+        """Build from the initial coordinates and the
+        ``coherent_assignments`` output (Morton-ordered contiguous
+        runs) — the frame derivation mirrors the partitioner's
+        exactly (lo = min, zero-span guard) so partition-time rows
+        route back into their own subsets."""
+        from smk_tpu.parallel.partition import MORTON_BITS, morton_codes
+
+        c = np.asarray(coords, np.float64)
+        lo = c.min(axis=0)
+        span = c.max(axis=0) - lo
+        span = np.where(span > 0, span, 1.0)
+        code = morton_codes(c, lo=lo, span=span)
+        k = len(assignments)
+        bounds = np.asarray(
+            [
+                code[np.asarray(assignments[j])].min()
+                for j in range(1, k)
+            ],
+            np.uint64,
+        )
+        return cls(
+            lo=lo, span=span, bits=MORTON_BITS,
+            boundaries=bounds, n_subsets=k,
+        )
+
+    def route(self, coords_new) -> np.ndarray:
+        """Subset index per new row — deterministic, vectorized."""
+        from smk_tpu.parallel.partition import morton_codes
+
+        c = np.asarray(coords_new, np.float64)
+        if c.ndim != 2 or c.shape[1] != self.lo.shape[0]:
+            raise IngestError(
+                f"coords_new must be (b, d={self.lo.shape[0]}), got "
+                f"shape {c.shape}"
+            )
+        code = morton_codes(
+            c, lo=self.lo, span=self.span, bits=self.bits
+        )
+        return np.searchsorted(
+            self.boundaries, code, side="right"
+        ).astype(np.int64)
+
+
+class _CombinedFit(NamedTuple):
+    """The minimal combined-posterior surface
+    ``serve/artifact.save_artifact`` consumes (duck-typed for
+    ``plugin_phi_layout``): the combined grids and the resampled
+    composition draws."""
+
+    sample_par: np.ndarray
+    sample_w: np.ndarray
+    param_grid: np.ndarray
+    w_grid: np.ndarray
+
+
+class LiveFit:
+    """One live model: the growable dataset, its coherent partition,
+    the carried per-subset posteriors, and the generation directory
+    the fleet serves from. See the module docstring for the loop
+    contract; knobs:
+
+    ``gen_dir``: the generation directory (created on first publish).
+    ``config``: an :class:`~smk_tpu.config.SMKConfig` with
+    ``partition_method="coherent"`` (the router IS the coherent
+    partition's code arithmetic — a random partition has no spatial
+    routing and is a typed error here).
+    ``coords_test`` / ``x_test``: the anchor grid every generation
+    predicts at (frozen — generations must be hot-swappable, which
+    requires stable artifact geometry).
+    ``chunk_iters``: chunked-executor boundary length (defaults to
+    the config's checkpoint cadence heuristic, 500).
+    ``pipeline_stats``: a shared
+    :class:`~smk_tpu.utils.tracing.ChunkPipelineStats`; the ingest
+    ledger (``pstats.ingest``) accumulates here.
+    """
+
+    def __init__(
+        self,
+        gen_dir: str,
+        *,
+        config,
+        coords_test,
+        x_test,
+        weight: int = 1,
+        chunk_iters: Optional[int] = None,
+        pipeline_stats=None,
+    ):
+        if config.partition_method != "coherent":
+            raise IngestError(
+                "LiveFit requires partition_method='coherent' — the "
+                "ingest router is the Morton partition's own code "
+                "arithmetic; a random partition cannot route new "
+                "observations spatially"
+            )
+        self.gen_dir = str(gen_dir)
+        self.cfg = config
+        self.weight = int(weight)
+        self.chunk_iters = chunk_iters
+        self.coords_test = np.asarray(coords_test)
+        self.x_test = np.asarray(x_test)
+        if pipeline_stats is None:
+            from smk_tpu.utils.tracing import ChunkPipelineStats
+
+            pipeline_stats = ChunkPipelineStats()
+        self.pstats = pipeline_stats
+        if self.pstats.ingest is None:
+            self.pstats.ingest = {
+                "ingest_batches": 0,
+                "ingested_rows": 0,
+                "refits": 0,
+                "full_refits": 0,
+                "reused_subsets_total": 0,
+                "refit_subsets_total": 0,
+                "generation": None,
+            }
+        self._model = None
+        self._y = self._x = self._coords = None
+        self._assignments: Optional[list] = None
+        self._router: Optional[MortonRouter] = None
+        self._subset_results = None  # SubsetResult of np arrays, K-leading
+        self._param_grid = None  # previous combined grid (warm start)
+        self._dirty: set = set()
+        self._full_fit_wall: Optional[float] = None
+        self._run_log = None
+        if getattr(config, "run_log_dir", None):
+            from smk_tpu.obs.events import open_run_log
+
+            self._run_log = open_run_log(
+                config.run_log_dir, name="livefit",
+                meta={
+                    "n_subsets": config.n_subsets,
+                    "gen_dir": self.gen_dir,
+                },
+            )
+            self.pstats.run_log = self._run_log
+
+    # -- observability -------------------------------------------------
+
+    def _event(self, name: str, **attrs) -> None:
+        if self._run_log is not None:
+            try:
+                self._run_log.event(name, **attrs)
+            except Exception:  # pragma: no cover - defensive
+                self._run_log = None
+
+    # -- state ---------------------------------------------------------
+
+    @property
+    def fitted(self) -> bool:
+        return self._subset_results is not None
+
+    @property
+    def generation(self) -> Optional[int]:
+        cur = current_generation(self.gen_dir)
+        return None if cur is None else int(cur["generation"])
+
+    @property
+    def n_rows(self) -> int:
+        return 0 if self._y is None else int(self._y.shape[0])
+
+    @property
+    def dirty_subsets(self) -> Tuple[int, ...]:
+        return tuple(sorted(self._dirty))
+
+    @property
+    def subset_sizes(self) -> Tuple[int, ...]:
+        if self._assignments is None:
+            return ()
+        return tuple(len(a) for a in self._assignments)
+
+    def _ladder(self):
+        from smk_tpu.compile.buckets import bucket_ladder, validate_ladder
+
+        if self.cfg.bucket_ladder is not None:
+            return validate_ladder(self.cfg.bucket_ladder)
+        return bucket_ladder(max(self.subset_sizes))
+
+    def _group_sets(self, subsets) -> Tuple[Tuple[int, ...], float]:
+        """(dirty bucket-group rungs, dirty-group fraction): a group
+        is the set of subsets sharing a ladder rung — the execution
+        unit the chunked ragged driver fits."""
+        from smk_tpu.compile.buckets import bucket_for
+
+        lad = self._ladder()
+        rung_of = [bucket_for(s, lad) for s in self.subset_sizes]
+        all_groups = set(rung_of)
+        dirty_groups = sorted({rung_of[j] for j in subsets})
+        frac = len(dirty_groups) / len(all_groups) if all_groups else 0.0
+        return tuple(dirty_groups), frac
+
+    # -- validation ----------------------------------------------------
+
+    def _validate_batch(self, y_new, x_new, coords_new):
+        y = np.asarray(y_new, np.float64)
+        c = np.asarray(coords_new, np.float64)
+        q = int(self._y.shape[1])
+        p = int(self._x.shape[-1])
+        d = int(self._coords.shape[1])
+        if y.ndim != 2 or y.shape[1] != q:
+            raise IngestError(
+                f"y_new must be (b, q={q}) responses, got shape "
+                f"{y.shape}"
+            )
+        b = y.shape[0]
+        if c.shape != (b, d):
+            raise IngestError(
+                f"coords_new must be (b={b}, d={d}) locations, got "
+                f"shape {c.shape}"
+            )
+        if x_new is None:
+            if not self._ones_design:
+                raise IngestError(
+                    "x_new=None is only valid when the fit's design "
+                    "is intercept-only (all-ones) — this fit carries "
+                    "real covariates; pass x_new explicitly"
+                )
+            x = np.ones((b, q, p), np.float64)
+        else:
+            x = np.asarray(x_new, np.float64)
+            if x.shape != (b, q, p):
+                raise IngestError(
+                    f"x_new must be (b={b}, q={q}, p={p}) designs, "
+                    f"got shape {x.shape}"
+                )
+        for name, a in (("y_new", y), ("x_new", x), ("coords_new", c)):
+            if not np.isfinite(a).all():
+                raise IngestError(
+                    f"{name} contains non-finite values — rejected "
+                    "at the boundary (a NaN coordinate would route "
+                    "arbitrarily; a NaN response would poison its "
+                    "subset's next re-fit)"
+                )
+        return y, x, c
+
+    # -- the fit/refit executor ---------------------------------------
+
+    def _fit_subsets(self, key, assignments, beta_init):
+        """Fit the named assignment arrays as their own
+        PaddedPartition through the chunked executor; returns the
+        stacked SubsetResult as HOST numpy leaves (carried state must
+        not pin device memory)."""
+        import jax
+
+        from smk_tpu.parallel.partition import padded_partition
+        from smk_tpu.parallel.recovery import fit_subsets_chunked
+
+        part = padded_partition(
+            self._y, self._x, self._coords, assignments,
+            ladder=self._ladder(),
+        )
+        results = fit_subsets_chunked(
+            self._model, part,
+            self.coords_test, self.x_test,
+            key, beta_init,
+            chunk_iters=self.chunk_iters or 500,
+            pipeline_stats=self.pstats,
+        )
+        return jax.tree_util.tree_map(np.asarray, results)
+
+    def _combine(self, k_res, results) -> _CombinedFit:
+        """The combine tail over ALL K grids (cheap): geometric-
+        median/average quantile grids → dense interpolation →
+        inverse-CDF composition resample. Same sequence as
+        api._fit_meta_kriging_impl's combine + resample phases."""
+        import jax.numpy as jnp
+
+        from smk_tpu.ops.quantiles import (
+            interp_quantile_grid,
+            inverse_cdf_resample,
+        )
+        from smk_tpu.parallel.combine import combine_quantile_grids
+
+        cfg = self.cfg
+        param_grid = combine_quantile_grids(
+            jnp.asarray(results.param_grid), cfg.combiner,
+            n_iter=cfg.weiszfeld_iters, eps=cfg.weiszfeld_eps,
+        )
+        w_grid = combine_quantile_grids(
+            jnp.asarray(results.w_grid), cfg.combiner,
+            n_iter=cfg.weiszfeld_iters, eps=cfg.weiszfeld_eps,
+        )
+        dense_par = interp_quantile_grid(
+            param_grid, cfg.interp_grid_step
+        )
+        dense_w = interp_quantile_grid(w_grid, cfg.interp_grid_step)
+        sample_par, sample_w = inverse_cdf_resample(
+            k_res, [dense_par, dense_w], cfg.resample_size
+        )
+        out = _CombinedFit(
+            sample_par=np.asarray(sample_par),
+            sample_w=np.asarray(sample_w),
+            param_grid=np.asarray(param_grid),
+            w_grid=np.asarray(w_grid),
+        )
+        self._param_grid = out.param_grid
+        return out
+
+    def _warm_beta(self):
+        """Warm start from the previous COMBINED posterior's median
+        betas — carried state, not a fresh GLM pass: the previous
+        generation already localized the coefficient posterior, and
+        the new rows are a small perturbation of it."""
+        from smk_tpu.api import _median_row
+
+        q = int(self._y.shape[1])
+        p = int(self._x.shape[-1])
+        grid = self._param_grid
+        row = grid[_median_row(grid.shape[0])]
+        return np.asarray(row[: q * p], np.float64).reshape(q, p)
+
+    def _publish(self, key, kind: str, extra_meta: dict) -> dict:
+        import jax
+
+        k_res = jax.random.fold_in(key, 0x1E57)
+        combined = self._combine(k_res, self._subset_results)
+        self._last_combined = combined
+        manifest = publish_generation(
+            self.gen_dir, combined, self.coords_test,
+            config=self.cfg,
+            meta={"kind": kind, **extra_meta},
+        )
+        self.pstats.ingest["generation"] = int(manifest["generation"])
+        self._event(
+            "generation_published",
+            generation=int(manifest["generation"]), kind=kind,
+            **{
+                k: v for k, v in extra_meta.items()
+                if isinstance(v, (int, float, str, bool, list))
+            },
+        )
+        return manifest
+
+    # -- public loop ---------------------------------------------------
+
+    def fit(self, key, y, x, coords) -> dict:
+        """The initial full fit: coherent partition, GLM warm start,
+        chunked executor over every bucket group, combine, publish
+        generation 0 (or committed+1 when the directory already holds
+        generations). Returns the committed manifest."""
+        import jax
+
+        from smk_tpu.api import glm_warm_start, stacked_design
+        from smk_tpu.models.probit_gp import SpatialGPSampler
+        from smk_tpu.parallel.partition import coherent_assignments
+
+        cfg = self.cfg
+        y = np.asarray(y, np.float64)
+        x = np.asarray(x, np.float64)
+        coords = np.asarray(coords, np.float64)
+        if y.ndim != 2 or x.ndim != 3 or coords.ndim != 2:
+            raise IngestError(
+                f"fit expects y (n, q), x (n, q, p), coords (n, d); "
+                f"got {y.shape}, {x.shape}, {coords.shape}"
+            )
+        self._y, self._x, self._coords = y, x, coords
+        self._ones_design = bool(np.all(x == 1))
+        self._assignments = [
+            np.asarray(a, np.int64)
+            for a in coherent_assignments(coords, cfg.n_subsets)
+        ]
+        self._router = MortonRouter.from_assignments(
+            coords, self._assignments
+        )
+        self._model = SpatialGPSampler(cfg, weight=self.weight)
+        k_fit, k_pub = jax.random.split(jax.random.key(0) if key is None else key)
+        import jax.numpy as jnp
+
+        y_long, x_long = stacked_design(
+            jnp.asarray(y), jnp.asarray(x)
+        )
+        glm = glm_warm_start(
+            y_long, x_long, weight=self.weight, link=cfg.link
+        )
+        q, p = x.shape[1], x.shape[2]
+        beta_init = np.asarray(glm.coef).reshape(q, p)
+        t0 = monotonic()
+        self._subset_results = self._fit_subsets(
+            k_fit, self._assignments, beta_init
+        )
+        self._full_fit_wall = monotonic() - t0
+        self._dirty.clear()
+        return self._publish(
+            k_pub, "fit",
+            {"n_rows": self.n_rows, "n_subsets": cfg.n_subsets},
+        )
+
+    def ingest(self, y_new, x_new=None, coords_new=None) -> IngestReceipt:
+        """Append a batch of observations: route each row to its
+        Morton subset, mark the touched subsets dirty, and return a
+        receipt. No device work, no republish — the fleet keeps
+        serving the current generation until :meth:`refit`."""
+        if not self.fitted:
+            raise IngestError(
+                "ingest before the initial fit — call LiveFit.fit "
+                "first (the router is frozen at fit time)"
+            )
+        if coords_new is None:
+            raise IngestError("coords_new is required")
+        y, x, c = self._validate_batch(y_new, x_new, coords_new)
+        subs = self._router.route(c)
+        base = self.n_rows
+        self._y = np.concatenate([self._y, y])
+        self._x = np.concatenate([self._x, x])
+        self._coords = np.concatenate([self._coords, c])
+        for i, j in enumerate(subs):
+            j = int(j)
+            self._assignments[j] = np.concatenate(
+                [self._assignments[j], np.asarray([base + i])]
+            )
+            self._dirty.add(j)
+        groups, frac = self._group_sets(sorted(self._dirty))
+        led = self.pstats.ingest
+        led["ingest_batches"] += 1
+        led["ingested_rows"] += int(y.shape[0])
+        led["dirty_subsets"] = list(self.dirty_subsets)
+        led["dirty_groups"] = list(groups)
+        led["dirty_group_frac"] = round(frac, 4)
+        self._event(
+            "ingest_routed",
+            n_rows=int(y.shape[0]),
+            routed_subsets=sorted({int(j) for j in subs}),
+            dirty_subsets=list(self.dirty_subsets),
+            dirty_groups=list(groups),
+        )
+        return IngestReceipt(
+            n_rows=int(y.shape[0]),
+            routed_subsets=tuple(int(j) for j in subs),
+            dirty_subsets=self.dirty_subsets,
+            dirty_groups=groups,
+            dirty_group_frac=frac,
+            generation=self.generation,
+        )
+
+    def refit(
+        self,
+        key,
+        *,
+        full: bool = False,
+        subsets: Optional[Sequence[int]] = None,
+    ) -> RefitReport:
+        """Re-fit and republish. Default: ONLY the dirty subsets, as
+        their own bucket groups, warm-started from the previous
+        combined posterior; their fresh grids/draws are spliced into
+        the carried K-stacks (untouched subsets bit-identical) and
+        the combine tail re-runs over all K grids. ``full=True``
+        re-fits every subset (the matched-floor baseline the speedup
+        headline divides by). ``subsets=[...]`` forces an explicit
+        target set (protocol/bench use). The per-subset MCMC schedule
+        is IDENTICAL in every mode — the convergence floor is matched
+        by construction, so ``refit_speedup`` is a like-for-like
+        wall ratio."""
+        import jax
+
+        if not self.fitted:
+            raise IngestError(
+                "refit before the initial fit — call LiveFit.fit first"
+            )
+        k = self.cfg.n_subsets
+        if full:
+            target = list(range(k))
+        elif subsets is not None:
+            target = sorted({int(j) for j in subsets})
+            if target and not (
+                0 <= target[0] and target[-1] < k
+            ):
+                raise IngestError(
+                    f"subsets must lie in [0, K={k}), got {target}"
+                )
+        else:
+            target = sorted(self._dirty)
+        if not target:
+            return RefitReport(
+                generation=self.generation,
+                refit_subsets=(), reused_subsets=tuple(range(k)),
+                dirty_group_frac=0.0, refit_wall_s=0.0,
+                full_fit_wall_s=self._full_fit_wall,
+                refit_speedup=None, param_rhat_max=None,
+                skipped=True,
+            )
+        groups, frac = self._group_sets(target)
+        reused = tuple(j for j in range(k) if j not in set(target))
+        self._event(
+            "refit_scheduled",
+            refit_subsets=list(target),
+            reused_subsets=len(reused),
+            dirty_groups=list(groups), full=bool(full),
+        )
+        k_fit = jax.random.fold_in(key, len(target))
+        beta_init = self._warm_beta()
+        t0 = monotonic()
+        fresh = self._fit_subsets(
+            k_fit, [self._assignments[j] for j in target], beta_init
+        )
+        wall = monotonic() - t0
+        idx = np.asarray(target, np.int64)
+        if len(target) == k:
+            spliced = fresh
+        else:
+            def splice(old, new):
+                old = np.asarray(old)
+                new = np.asarray(new)
+                if old.shape[1:] != new.shape[1:]:
+                    raise IngestError(
+                        "re-fit leaves changed shape "
+                        f"{old.shape[1:]} -> {new.shape[1:]} — the "
+                        "refit schedule must match the carried "
+                        "stacks (same n_samples/burn_in/quantiles) "
+                        "to splice"
+                    )
+                out = old.copy()
+                out[idx] = new
+                return out
+
+            import jax as _jax
+
+            spliced = _jax.tree_util.tree_map(
+                splice, self._subset_results, fresh
+            )
+        self._subset_results = spliced
+        self._dirty.difference_update(target)
+        if len(target) == k:
+            self._full_fit_wall = wall
+        speedup = None
+        if (
+            len(target) < k
+            and self._full_fit_wall
+            and wall > 0
+        ):
+            speedup = self._full_fit_wall / wall
+        rhat = np.asarray(fresh.param_rhat, np.float64)
+        rhat_max = (
+            float(np.nanmax(rhat)) if rhat.size else None
+        )
+        led = self.pstats.ingest
+        led["refits"] += 1
+        if len(target) == k:
+            led["full_refits"] += 1
+        led["reused_subsets_total"] += len(reused)
+        led["refit_subsets_total"] += len(target)
+        led["last_refit_wall_s"] = round(wall, 4)
+        led["last_refit_speedup"] = (
+            round(speedup, 3) if speedup else None
+        )
+        led["dirty_subsets"] = list(self.dirty_subsets)
+        manifest = self._publish(
+            jax.random.fold_in(key, 0xF17), "refit",
+            {
+                "refit_subsets": list(target),
+                "reused_subsets": len(reused),
+                "full": bool(full),
+                "wall_s": round(wall, 4),
+            },
+        )
+        return RefitReport(
+            generation=int(manifest["generation"]),
+            refit_subsets=tuple(target),
+            reused_subsets=reused,
+            dirty_group_frac=frac,
+            refit_wall_s=wall,
+            full_fit_wall_s=self._full_fit_wall,
+            refit_speedup=speedup,
+            param_rhat_max=rhat_max,
+        )
+
+    # -- serving integration ------------------------------------------
+
+    def load_current(self):
+        """(FitArtifact, manifest) of the committed generation."""
+        return load_current_generation(self.gen_dir)
+
+    def swap_into(self, target) -> dict:
+        """Hot-swap an engine or fleet onto the committed generation
+        (zero dropped requests — see
+        ``PredictionEngine.swap_artifact``). Returns the swap
+        summary."""
+        art, manifest = load_current_generation(self.gen_dir)
+        return target.swap_artifact(
+            art, generation=int(manifest["generation"])
+        )
+
+    def close(self) -> None:
+        if self._run_log is not None:
+            self._run_log.close(ingest=self.pstats.ingest)
+            self._run_log = None
+
+    def __enter__(self) -> "LiveFit":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -177,6 +177,10 @@ class ChunkPipelineStats:
     # kept counts plus the dispatch-slot accounting — None on
     # fixed-schedule runs.
     adaptive: Any = None
+    # streaming-ingest ledger (ISSUE 19, serve/ingest.py LiveFit):
+    # batches routed, rows ingested, refit vs. reused subset counts
+    # and the committed generation — None outside the live-fit loop.
+    ingest: Any = None
     _lock: threading.Lock = field(
         default_factory=threading.Lock, repr=False
     )
@@ -410,6 +414,10 @@ class ChunkPipelineStats:
                 and self._ess_sum_final() is not None
                 else None
             ),
+            # ISSUE 19 streaming-ingest ledger (None outside the
+            # live-fit loop): routed batches, dirty vs. reused
+            # subsets, committed generation
+            "ingest": self.ingest,
             # ISSUE 7 fault-isolation accounting: policy, retry
             # ladder history, and the final dropped-subset set —
             # JSON-friendly (string subset ids) for bench/protocol

@@ -717,3 +717,39 @@ class TestServePassThrough:
         assert cfg.coalesce_window_ms == 25.0
         with pytest.raises(ValueError, match="coalesce_window_ms"):
             smk.SMKConfig(coalesce_window_ms=-1.0)
+
+
+class TestLiveFitWiring:
+    def test_live_fit_ingest_refit_wired(self):
+        """The ISSUE 19 front-end additions: smk.live.fit must build
+        a coherent-partition SMKConfig and construct serve$LiveFit,
+        smk.ingest must pass the routed batch through LiveFit$ingest
+        without republishing, and smk.refit must surface $generation
+        and $refit.speedup on the result list (source-checked — the
+        loop itself is exercised end-to-end in
+        tests/test_ingest.py)."""
+        import os
+
+        r_src = open(
+            os.path.join(
+                os.path.dirname(os.path.dirname(__file__)),
+                "r", "meta_kriging_tpu.R",
+            )
+        ).read()
+        assert "smk.live.fit <- function(gen.dir" in r_src
+        assert "smk.ingest <- function(gen.dir" in r_src
+        assert "smk.refit <- function(gen.dir" in r_src
+        # the router is the coherent partition's own code arithmetic
+        assert 'partition_method = "coherent"' in r_src
+        assert "serve$LiveFit" in r_src
+        # one live fit per gen.dir per session, like the engine cache
+        assert ".smk.live.fits" in r_src
+        assert "get0(gen.dir, envir = .smk.live.fits)" in r_src
+        # ingest routes but never republishes
+        assert "do.call(live$ingest, args)" in r_src
+        assert "dirty.subsets = as.integer(unlist(receipt$dirty_subsets))" in r_src
+        # the refit result carries the generation + speedup contract
+        assert "live$refit(" in r_src
+        assert "as.integer(report$generation)" in r_src
+        assert "refit.speedup = report$refit_speedup" in r_src
+        assert "skipped = isTRUE(report$skipped)" in r_src

@@ -1551,10 +1551,118 @@ class TestScheduleDiscipline:
         assert "SMK118" in rules_hit(broken, path=real)
 
 
+class TestGenerationPublicationRule:
+    """SMK119 (ISSUE 19): generation publication — an atomic rename
+    with manifest/generation naming in reach — may only live in
+    serve/artifact.py and parallel/checkpoint.py.  A second publisher
+    forks the two-phase commit protocol, so its generations are
+    invisible to rollback/orphan recovery."""
+
+    def test_manifest_rename_flagged(self):
+        src = (
+            "import os\n"
+            "def publish(d, tmp):\n"
+            "    os.replace(tmp, os.path.join(d, 'MANIFEST.json'))\n"
+        )
+        assert lines_hit(src, "SMK119", path=OPS_PATH) == [3]
+
+    def test_marker_in_enclosing_function_flagged(self):
+        # the literal lives in path construction, not the call args
+        src = (
+            "import os\n"
+            "def publish(d, tmp):\n"
+            "    path = os.path.join(d, 'generation.json')\n"
+            "    os.replace(tmp, path)\n"
+        )
+        assert lines_hit(src, "SMK119", path=OPS_PATH) == [4]
+
+    def test_from_import_alias_and_path_method_flagged(self):
+        src = (
+            "from os import replace as _mv\n"
+            "def publish(d, tmp):\n"
+            "    _mv(tmp, d + '/MANIFEST.json')\n"
+        )
+        assert "SMK119" in rules_hit(src, path=OPS_PATH)
+        src2 = (
+            "def publish(tmp, live):\n"
+            "    manifest = 'generation 3'\n"
+            "    tmp.rename(live)\n"
+        )
+        assert "SMK119" in rules_hit(src2, path=OPS_PATH)
+
+    def test_plain_temp_commit_and_non_renames_clean(self):
+        # a generic temp+rename commit with no manifest/generation
+        # naming is SMK113's jurisdiction, not a protocol fork
+        src = (
+            "import os\n"
+            "def save(path, blob):\n"
+            "    tmp = path + '.tmp'\n"
+            "    _write(tmp, blob)\n"
+            "    os.replace(tmp, path)\n"
+        )
+        assert "SMK119" not in rules_hit(src, path=OPS_PATH)
+        # dataclasses.replace / str munging are not filesystem renames
+        src2 = (
+            "import dataclasses\n"
+            "def f(cfg, name):\n"
+            "    cfg2 = dataclasses.replace(cfg, generation=1)\n"
+            "    return name\n"
+        )
+        assert "SMK119" not in rules_hit(src2, path=OPS_PATH)
+
+    def test_docstring_mention_alone_clean(self):
+        src = (
+            "import os\n"
+            "def save(path, blob):\n"
+            "    '''Commit blob; the GENERATION manifest lives\n"
+            "    elsewhere (serve/artifact.py).'''\n"
+            "    tmp = path + '.tmp'\n"
+            "    os.replace(tmp, path)\n"
+        )
+        assert "SMK119" not in rules_hit(src, path=OPS_PATH)
+
+    def test_sanctioned_zones_and_outside_tree_exempt(self):
+        src = (
+            "import os\n"
+            "def commit(d, tmp):\n"
+            "    os.replace(tmp, os.path.join(d, 'MANIFEST.json'))\n"
+        )
+        for zone in (
+            "smk_tpu/serve/artifact.py",
+            "smk_tpu/parallel/checkpoint.py",
+        ):
+            assert "SMK119" not in rules_hit(src, path=zone), zone
+        assert "SMK119" not in rules_hit(src, path=SCRIPT_PATH)
+        assert "SMK119" not in rules_hit(src, path=TESTS_PATH)
+
+    def test_suppression_with_justification(self):
+        src = (
+            "import os\n"
+            "def migrate(d, tmp):\n"
+            "    os.replace(tmp, d + '/MANIFEST.json')  "
+            "# smklint: disable=SMK119 -- one-shot layout migration "
+            "tool, runs before any publisher exists\n"
+        )
+        hits = rules_hit(src, path=OPS_PATH)
+        assert "SMK119" not in hits and "SMK100" not in hits
+
+    def test_real_ingest_clean_and_seeded_defect_caught(self):
+        real = "smk_tpu/serve/ingest.py"
+        src = repo_file(real)
+        assert "SMK119" not in rules_hit(src, path=real)
+        broken = src + (
+            "\n\ndef _fast_publish(gen_dir, tmp):\n"
+            "    import os\n"
+            "    os.replace(tmp, gen_dir + '/MANIFEST.json')\n"
+        )
+        assert "SMK119" in rules_hit(broken, path=real)
+
+
 @pytest.mark.parametrize("rule_id", [
     "SMK101", "SMK102", "SMK103", "SMK104", "SMK105", "SMK106",
     "SMK107", "SMK108", "SMK109", "SMK110", "SMK111", "SMK112",
     "SMK113", "SMK114", "SMK115", "SMK116", "SMK117", "SMK118",
+    "SMK119",
 ])
 def test_every_rule_documented_in_catalogue(rule_id):
     from smk_tpu.analysis.lint import _list_rules
