@@ -152,6 +152,22 @@ subprocess-isolated chaos sibling → INGEST_r20.jsonl: untouched-
 subset bit-identity, warm >2x speedup, kill-mid-publish rollback,
 serve-during-swap never-torn).
 
+BENCH_VECCHIA=1 appends the ISSUE 20 sparse-subset-engine rung: the
+same public fit run twice at per-subset size m=BENCH_VECCHIA_M —
+subset_engine="dense" (O(m^3) build+factor) vs "vecchia" (the
+nearest-neighbour sparse-precision build, O(m*nn^3) flops /
+O(m*nn) HBM) — on the IDENTICAL MCMC schedule (matched convergence
+floor by construction; both arms stamp ess_per_second), plus a
+vecchia-only leg at BENCH_VECCHIA_M2 (default 2m), the size where
+the dense per-subset m x m build is undispatchable. Stamps
+wall_dense_s / wall_vecchia_s / vecchia_beats_dense /
+m_large_completes. BENCH_VECCHIA_M / BENCH_VECCHIA_M2 /
+BENCH_VECCHIA_K / BENCH_VECCHIA_ITERS / BENCH_VECCHIA_NN resize
+(scripts/vecchia_probe.py is the subprocess-isolated correctness
+sibling → VECCHIA_r21.jsonl: dense-default bit-identity to the
+pre-PR tree, warm-store zero-compile, kill/resume bit-identity,
+dense-vecchia posterior agreement, bf16-build parity).
+
 Synthetic latent surfaces use random Fourier features (an O(n)
 stationary GP approximation) so data generation never needs an n x n
 factorization.
@@ -1655,6 +1671,124 @@ def run_rung_ingest(name, *, solver_env=None, n=None, k=None,
     return out
 
 
+def run_rung_vecchia(name, *, solver_env=None, m=None, k=None,
+                     n_samples=None, n_neighbors=None, n_test=32):
+    """BENCH_VECCHIA=1 (ISSUE 20): the sparse-subset-engine m-scaling
+    rung.
+
+    Two arms through the PUBLIC fit at per-subset size m =
+    BENCH_VECCHIA_M: ``subset_engine="dense"`` (the O(m^3)/O(m^2)
+    historical path) vs ``subset_engine="vecchia"`` (the
+    O(m*nn^3)/O(m*nn) sparse-precision build), IDENTICAL MCMC
+    schedule both arms — same n_samples, same chunking, same keys —
+    so the convergence floor is matched by construction and the
+    wall ratio is mixing-honest (both arms also stamp the streaming
+    ``ess_per_second``). Both arms run the vecchia-compatible knob
+    set (u_solver="chol", conditional phi, fused_build="off") so the
+    ONLY difference measured is the subset engine. A third
+    vecchia-only leg runs at BENCH_VECCHIA_M2 (default 2*m) — the
+    size where the dense per-subset m x m build is undispatchable on
+    a real HBM budget — and stamps that it completes with finite
+    grids. BENCH_VECCHIA_M / BENCH_VECCHIA_M2 / BENCH_VECCHIA_K /
+    BENCH_VECCHIA_ITERS / BENCH_VECCHIA_NN resize
+    (scripts/vecchia_probe.py is the subprocess-isolated correctness
+    sibling emitting VECCHIA_r21.jsonl)."""
+    import dataclasses
+
+    from smk_tpu.api import fit_meta_kriging
+    from smk_tpu.utils.tracing import ChunkPipelineStats, device_sync
+
+    env = solver_env or {}
+    m = m or int(os.environ.get("BENCH_VECCHIA_M", 4096))
+    m2 = int(os.environ.get("BENCH_VECCHIA_M2", 2 * m))
+    k = k or int(os.environ.get("BENCH_VECCHIA_K", 2))
+    n_samples = n_samples or int(
+        os.environ.get("BENCH_VECCHIA_ITERS", 32)
+    )
+    nn = n_neighbors or int(os.environ.get("BENCH_VECCHIA_NN", 16))
+
+    base = dataclasses.replace(
+        rung_config(
+            env, k=k, n_samples=n_samples,
+            cov_model="exponential", link="probit",
+        ),
+        # vecchia's latent update is the exact sparse-precision CG on
+        # Q = F^T F; the dense arm runs the SAME solver family
+        # (u_solver="chol", conditional phi, no fused build) so the
+        # engine is the only measured variable
+        u_solver="chol", phi_sampler="conditional", phi_proposals=1,
+        fused_build="off",
+    )
+    # >= 4 kept chunks so the streaming batch-means ESS exists by the
+    # final boundary (one batch per chunk) and ess_per_second is a
+    # real number at this rung's small default iteration budget
+    kept = base.n_samples - base.n_burn_in
+    chunk_iters = int(
+        env.get("BENCH_CHUNK_ITERS", max(2, kept // 4))
+    )
+
+    def _arm(n_rows, cfg):
+        n_all = n_rows + n_test
+        y, x, coords = make_binary_field(jax.random.key(3), n_all)
+        pstats = ChunkPipelineStats()
+        t0 = time.time()
+        res = fit_meta_kriging(
+            jax.random.key(2), y[:n_rows], x[:n_rows],
+            coords[:n_rows], coords[n_rows:], x[n_rows:],
+            config=cfg, chunk_iters=chunk_iters,
+            pipeline_stats=pstats,
+        )
+        device_sync((res.param_grid, res.p_quant))
+        wall = time.time() - t0
+        agg = pstats.aggregate()
+        eps = agg["ess_per_second"]
+        return {
+            "wall_s_incl_compile": round(wall, 2),
+            "fit_s": round(
+                res.phase_seconds.get("subset_fits", 0.0), 2
+            ),
+            "ess_per_second": (
+                eps if eps is not None and math.isfinite(eps)
+                else None
+            ),
+            "finite": bool(
+                np.isfinite(np.asarray(res.p_quant)).all()
+                and np.isfinite(np.asarray(res.param_grid)).all()
+            ),
+        }
+
+    dense = _arm(m * k, dataclasses.replace(base, subset_engine="dense"))
+    vecchia = _arm(m * k, dataclasses.replace(
+        base, subset_engine="vecchia", n_neighbors=nn,
+    ))
+    # the dense-undispatchable leg: at m2 the dense engine's per-site
+    # m x m correlation + factor no longer fits the per-core budget
+    # the README documents — only the sparse engine dispatches
+    big = _arm(m2 * k, dataclasses.replace(
+        base, subset_engine="vecchia", n_neighbors=nn,
+    ))
+    return {
+        "rung": name, "m": m, "K": k, "iters": n_samples,
+        "n_neighbors": nn, "public_path": True,
+        "wall_dense_s": dense["fit_s"],
+        "wall_vecchia_s": vecchia["fit_s"],
+        "wall_dense_incl_compile_s": dense["wall_s_incl_compile"],
+        "wall_vecchia_incl_compile_s": vecchia["wall_s_incl_compile"],
+        "ess_per_second_dense": dense["ess_per_second"],
+        "ess_per_second_vecchia": vecchia["ess_per_second"],
+        # matched-ESS-floor wall contract at the headline m: the
+        # sparse build+factor beats the dense m^3 one on the
+        # identical schedule
+        "vecchia_beats_dense": bool(
+            vecchia["fit_s"] < dense["fit_s"]
+        ),
+        "finite": bool(dense["finite"] and vecchia["finite"]),
+        "m_large": m2,
+        "wall_vecchia_m_large_s": big["fit_s"],
+        "m_large_completes": bool(big["finite"]),
+    }
+
+
 def run_rung(name, *, n, k, cov_model, n_samples, q=1, p=2, n_test=64,
              seed=0, solver_env=None, make_data=None, link="probit",
              budget_left=None, progress=None):
@@ -2806,6 +2940,23 @@ def main():
         except Exception as e:
             reporter.ladder.append(
                 {"rung": "ingest_refit", "error": repr(e)}
+            )
+            reporter.emit(partial=True)
+
+    # Sparse-engine rung (ISSUE 20): BENCH_VECCHIA=1 appends the
+    # dense-vs-vecchia m-scaling cell — matched-schedule walls +
+    # ess_per_second at m=BENCH_VECCHIA_M, plus the vecchia-only
+    # BENCH_VECCHIA_M2 leg at the dense-undispatchable size
+    # (scripts/vecchia_probe.py is the correctness sibling emitting
+    # VECCHIA_r21.jsonl). Reporter-first fallible like every cell.
+    if os.environ.get("BENCH_VECCHIA", "0") == "1":
+        try:
+            reporter.add_rung(run_rung_vecchia(
+                "vecchia_scaling", solver_env=env,
+            ))
+        except Exception as e:
+            reporter.ladder.append(
+                {"rung": "vecchia_scaling", "error": repr(e)}
             )
             reporter.emit(partial=True)
 

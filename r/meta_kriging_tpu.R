@@ -61,6 +61,10 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
                                                         "student_t",
                                                         "mixture"),
                                 fused.build = c("off", "pallas"),
+                                subset.engine = c("dense", "vecchia"),
+                                n.neighbors = 16L,
+                                build.dtype = c("float32",
+                                                "bfloat16"),
                                 partition.method = c("random",
                                                      "coherent"),
                                 bucket.ladder = NULL,
@@ -229,6 +233,19 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
   k.prior <- match.arg(k.prior)
   phi.proposal.family <- match.arg(phi.proposal.family)
   fused.build <- match.arg(fused.build)
+  # subset.engine: "vecchia" swaps the dense (m, m) subset
+  # factorization for the sparse Vecchia/NNGP precision — each site
+  # conditions on its n.neighbors nearest Morton predecessors, so the
+  # latent update runs in O(m * nn^3) flops and O(m * nn) memory
+  # instead of O(m^3)/O(m^2); subset sizes the dense engine cannot
+  # even dispatch become routine. The posterior is an approximation
+  # that sharpens as n.neighbors grows (16 is the literature's
+  # workhorse). "dense" (default) is the historical chain
+  # bit-identically. build.dtype = "bfloat16" evaluates the
+  # correlation build in bf16 and factors in fp32 (off by default;
+  # gated to the unfused build).
+  subset.engine <- match.arg(subset.engine)
+  build.dtype <- match.arg(build.dtype)
   partition.method <- match.arg(partition.method)
   chunk.pipeline <- match.arg(chunk.pipeline)
   adaptive.schedule <- match.arg(adaptive.schedule)
@@ -281,6 +298,9 @@ meta_kriging_binary <- function(y, x, coords, coords.test, x.test,
     phi_proposals = as.integer(phi.proposals),
     phi_proposal_family = phi.proposal.family,
     fused_build = fused.build,
+    subset_engine = subset.engine,
+    n_neighbors = as.integer(n.neighbors),
+    build_dtype = build.dtype,
     partition_method = partition.method,
     bucket_ladder = if (is.null(bucket.ladder)) NULL else
       as.integer(bucket.ladder),

@@ -1,0 +1,331 @@
+"""Vecchia sparse-engine protocol (ISSUE 20) -> VECCHIA_r21.jsonl.
+
+Evidence that the sparse subset engine (`subset_engine="vecchia"`,
+ops/vecchia.py) is a drop-in engine choice — not a fork of the
+sampler — at a CPU-feasible rung:
+
+1. dense_default_bit_identity — the golden pin: a fixed mini-fit
+   (seeded data, default `subset_engine="dense"`) hashes its
+   param_grid + w_grid to the sha256 recorded from the PRE-PR tree.
+   The default engine is bit-identical to the chain every earlier
+   protocol file certified; the vecchia machinery is provably
+   dormant until asked for.
+2. vecchia_warm_store_zero_compiles — deployment warmup works for
+   the sparse engine: `precompile()` on an empty store builds the
+   full vecchia program set AOT, and a FRESH model then fits under
+   `recompile_guard(max_compiles=0)` with every program served from
+   L2, bit-identical to the unguarded reference chain.
+3. vecchia_kill_resume — the packed Vecchia coefficients ride
+   `SamplerState.chol_r` through checkpoint v8: a chain killed after
+   3 chunks and resumed is BITWISE the uninterrupted chain.
+4. dense_vecchia_agreement — same data, same schedule, both engines:
+   finite chains on both arms, beta posterior medians within an
+   absolute band, phi posterior medians within a relative band
+   (vecchia is an approximation — agreement is statistical, bitwise
+   identity would be suspicious).
+5. bf16_build_parity — the ROADMAP item 5 MXU experiment:
+   `build_dtype="bfloat16"` (bf16 correlation build, fp32 factor)
+   under vecchia yields finite chains whose posterior medians sit in
+   the same bands relative to the fp32 build.
+
+The exit gate is the conjunction of EVERY boolean leaf — a regressed
+leg cannot ship a green VECCHIA file.
+
+Usage: JAX_PLATFORMS=cpu python scripts/vecchia_probe.py [out.jsonl]
+Runs on CPU in ~4-6 min (five small sampler fits' compiles dominate).
+"""
+
+import hashlib
+import os
+import sys
+import tempfile
+import warnings
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+jax.config.update("jax_platforms", "cpu")
+
+from smk_tpu.analysis.sanitizers import recompile_guard
+from smk_tpu.api import fit_meta_kriging
+from smk_tpu.compile.warmup import precompile
+from smk_tpu.config import SMKConfig
+from smk_tpu.models.probit_gp import SpatialProbitGP
+from smk_tpu.obs.reporter import write_records
+from smk_tpu.parallel.partition import random_partition
+from smk_tpu.parallel.recovery import fit_subsets_chunked
+from smk_tpu.utils.tracing import ChunkPipelineStats, monotonic
+
+# The pre-PR golden: sha256 over param_grid + w_grid bytes of the
+# mini-fit below, recorded from the tree at the last commit BEFORE
+# this PR (and re-verified identical on this tree while developing).
+# If the default-engine chain moves one bit, this leg goes red.
+GOLDEN_SHA256 = (
+    "bea2b76e8a6df7e6571dab00a054b1ac4c985586cfb243749a4490601d23ceb3"
+)
+
+K, N, Q, P, T = 4, 512, 1, 2, 6
+N_SAMPLES, CHUNK = 32, 8
+NN = 12
+BETA_BAND_ABS = 0.5   # posterior-median agreement bands: generous
+PHI_BAND_REL = 0.75   # enough to never flap, tight enough to catch
+                      # a broken engine (wrong posterior, sign flip)
+
+
+def quiet():
+    c = warnings.catch_warnings()
+    c.__enter__()
+    warnings.simplefilter("ignore")
+    return c
+
+
+def _bools(o):
+    """Every boolean leaf in a record tree — THE exit-gate walker
+    (same contract as chaos_probe/ingest_probe)."""
+    if isinstance(o, bool):
+        yield o
+    elif isinstance(o, dict):
+        for v in o.values():
+            yield from _bools(v)
+    elif isinstance(o, (list, tuple)):
+        for v in o:
+            yield from _bools(v)
+
+
+def golden_problem():
+    """EXACTLY the pinned mini-fit's data recipe — do not touch."""
+    rng = np.random.default_rng(7)
+    coords = rng.uniform(0, 1, (N, 2)).astype(np.float32)
+    x = rng.normal(size=(N, Q, P)).astype(np.float32)
+    y = rng.integers(0, 2, (N, Q)).astype(np.float32)
+    ct = rng.uniform(0, 1, (T, 2)).astype(np.float32)
+    xt = rng.normal(size=(T, Q, P)).astype(np.float32)
+    return y, x, coords, ct, xt
+
+
+def base_cfg(**kw):
+    return SMKConfig(
+        n_subsets=K, n_samples=N_SAMPLES, burn_in_frac=0.5,
+        n_quantiles=8, **kw,
+    )
+
+
+def posterior_meds(res):
+    sp = np.asarray(res.sample_par)
+    beta = np.median(sp[:, : Q * P], axis=0)
+    phi = float(np.median(sp[:, -1]))
+    return beta, phi
+
+
+def main(out_path="VECCHIA_r21.jsonl"):
+    records = []
+    y, x, coords, ct, xt = golden_problem()
+
+    # --- 1. dense default: golden-pinned bit identity ----------------
+    c = quiet()
+    try:
+        t0 = monotonic()
+        res_dense = fit_meta_kriging(
+            jax.random.key(3), y, x, coords, ct, xt, config=base_cfg()
+        )
+        dense_wall = monotonic() - t0
+    finally:
+        c.__exit__(None, None, None)
+    h = hashlib.sha256()
+    for a in (res_dense.param_grid, res_dense.w_grid):
+        h.update(np.asarray(a).tobytes())
+    got_sha = h.hexdigest()
+    records.append({
+        "record": "dense_default_bit_identity",
+        "claim": "the default subset_engine='dense' mini-fit hashes "
+                 "param_grid + w_grid to the sha256 recorded from "
+                 "the pre-PR tree — the historical chain is bitwise "
+                 "untouched and the vecchia machinery is dormant "
+                 "until asked for",
+        "n": N, "k": K, "n_samples": N_SAMPLES,
+        "fit_wall_s": round(dense_wall, 3),
+        "default_engine_is_dense": bool(
+            SMKConfig().subset_engine == "dense"
+        ),
+        "golden_sha256": GOLDEN_SHA256,
+        "got_sha256": got_sha,
+        "bit_identical_to_pre_pr_tree": bool(got_sha == GOLDEN_SHA256),
+    })
+
+    # Shared vecchia world for legs 2-3: one partition, one config
+    part = random_partition(
+        jax.random.key(0), y, x, coords, K
+    )
+
+    def vfit(cfg, seed_key=3, **kw):
+        model = SpatialProbitGP(cfg, weight=1)
+        return fit_subsets_chunked(
+            model, part, ct, xt, jax.random.key(seed_key),
+            chunk_iters=CHUNK, **kw,
+        )
+
+    # --- 2. precompile + zero-compile warm fit under vecchia ---------
+    tmp = tempfile.mkdtemp(prefix="vecchia_probe_")
+    sd = os.path.join(tmp, "store")
+    vcfg_store = base_cfg(
+        subset_engine="vecchia", n_neighbors=NN, compile_store_dir=sd
+    )
+    c = quiet()
+    try:
+        model0 = SpatialProbitGP(vcfg_store, weight=1)
+        t0 = monotonic()
+        report = precompile(model0, part, ct, xt, chunk_iters=CHUNK)
+        precompile_wall = monotonic() - t0
+        # unguarded reference fit: warms process-wide eager caches
+        # AND pins the draws the guarded fit must reproduce
+        ps_ref = ChunkPipelineStats()
+        ref = vfit(vcfg_store, pipeline_stats=ps_ref)
+        ps = ChunkPipelineStats()
+        with recompile_guard(0, "vecchia L2-warm fit"):
+            warm = vfit(vcfg_store, pipeline_stats=ps)
+        guard_ok = True
+    except Exception as e:  # pragma: no cover - the red path
+        guard_ok = False
+        raise
+    finally:
+        c.__exit__(None, None, None)
+    records.append({
+        "record": "vecchia_warm_store_zero_compiles",
+        "claim": "precompile() builds the full vecchia program set "
+                 "AOT into an empty store; a FRESH model then fits "
+                 "under recompile_guard(max_compiles=0) with every "
+                 "program served from L2, bit-identical to the "
+                 "unguarded reference chain",
+        "n_programs": int(report["n_programs"]),
+        "expected_programs": 4,
+        "full_program_set": bool(report["n_programs"] == 4),
+        "precompile_wall_s": round(precompile_wall, 3),
+        "zero_compiles_under_guard": guard_ok,
+        "all_programs_from_l2": bool(
+            {p["source"] for p in ps.programs} == {"l2"}
+        ),
+        "warm_bit_identical_to_reference": bool(
+            np.array_equal(
+                np.asarray(warm.param_grid), np.asarray(ref.param_grid)
+            )
+            and np.array_equal(
+                np.asarray(warm.w_grid), np.asarray(ref.w_grid)
+            )
+        ),
+    })
+
+    # --- 3. kill/resume bit identity under vecchia -------------------
+    ck = os.path.join(tmp, "v.ckpt.npz")
+    c = quiet()
+    try:
+        out = vfit(
+            vcfg_store, checkpoint_path=ck, stop_after_chunks=3
+        )
+        resumed = vfit(vcfg_store, checkpoint_path=ck)
+    finally:
+        c.__exit__(None, None, None)
+    records.append({
+        "record": "vecchia_kill_resume",
+        "claim": "a vecchia chain killed after 3 chunks and resumed "
+                 "from the v8 checkpoint (packed coefficients riding "
+                 "SamplerState.chol_r) is BITWISE the uninterrupted "
+                 "chain",
+        "stopped_returned_none": bool(out is None),
+        "checkpoint_written": bool(os.path.exists(ck)),
+        "resume_bit_identical": bool(
+            np.array_equal(
+                np.asarray(resumed.param_grid),
+                np.asarray(ref.param_grid),
+            )
+            and np.array_equal(
+                np.asarray(resumed.w_grid), np.asarray(ref.w_grid)
+            )
+        ),
+    })
+
+    # --- 4. dense vs vecchia posterior agreement ---------------------
+    c = quiet()
+    try:
+        t0 = monotonic()
+        res_v = fit_meta_kriging(
+            jax.random.key(3), y, x, coords, ct, xt,
+            config=base_cfg(subset_engine="vecchia", n_neighbors=NN),
+        )
+        vecchia_wall = monotonic() - t0
+    finally:
+        c.__exit__(None, None, None)
+    beta_d, phi_d = posterior_meds(res_dense)
+    beta_v, phi_v = posterior_meds(res_v)
+    beta_gap = float(np.max(np.abs(beta_d - beta_v)))
+    phi_gap = float(abs(phi_v - phi_d) / max(abs(phi_d), 1e-9))
+    records.append({
+        "record": "dense_vecchia_agreement",
+        "claim": "same data, same schedule, both engines: finite "
+                 "chains, beta posterior medians within "
+                 f"{BETA_BAND_ABS} absolute, phi medians within "
+                 f"{int(PHI_BAND_REL * 100)}% relative — vecchia "
+                 "(nn={}) approximates the dense posterior, it does "
+                 "not replace it with something else".format(NN),
+        "n_neighbors": NN,
+        "dense_wall_s": round(dense_wall, 3),
+        "vecchia_wall_s": round(vecchia_wall, 3),
+        "both_finite": bool(
+            np.isfinite(np.asarray(res_v.param_grid)).all()
+            and np.isfinite(np.asarray(res_v.w_grid)).all()
+            and np.isfinite(np.asarray(res_dense.param_grid)).all()
+        ),
+        "beta_median_dense": [round(float(b), 4) for b in beta_d],
+        "beta_median_vecchia": [round(float(b), 4) for b in beta_v],
+        "beta_gap_abs": round(beta_gap, 4),
+        "beta_within_band": bool(beta_gap < BETA_BAND_ABS),
+        "phi_median_dense": round(phi_d, 4),
+        "phi_median_vecchia": round(phi_v, 4),
+        "phi_gap_rel": round(phi_gap, 4),
+        "phi_within_band": bool(phi_gap < PHI_BAND_REL),
+    })
+
+    # --- 5. bf16 build parity under vecchia --------------------------
+    c = quiet()
+    try:
+        res_bf = fit_meta_kriging(
+            jax.random.key(3), y, x, coords, ct, xt,
+            config=base_cfg(
+                subset_engine="vecchia", n_neighbors=NN,
+                build_dtype="bfloat16",
+            ),
+        )
+    finally:
+        c.__exit__(None, None, None)
+    beta_b, phi_b = posterior_meds(res_bf)
+    beta_gap_b = float(np.max(np.abs(beta_b - beta_v)))
+    phi_gap_b = float(abs(phi_b - phi_v) / max(abs(phi_v), 1e-9))
+    records.append({
+        "record": "bf16_build_parity",
+        "claim": "build_dtype='bfloat16' (bf16 correlation build, "
+                 "fp32 factor/accumulate) under vecchia: finite "
+                 "chains whose posterior medians sit in the same "
+                 "bands relative to the fp32 build — the low-"
+                 "precision build perturbs, it does not corrupt",
+        "default_build_is_fp32": bool(
+            SMKConfig().build_dtype == "float32"
+        ),
+        "finite": bool(
+            np.isfinite(np.asarray(res_bf.param_grid)).all()
+            and np.isfinite(np.asarray(res_bf.w_grid)).all()
+        ),
+        "beta_gap_abs_vs_fp32": round(beta_gap_b, 4),
+        "beta_within_band": bool(beta_gap_b < BETA_BAND_ABS),
+        "phi_gap_rel_vs_fp32": round(phi_gap_b, 4),
+        "phi_within_band": bool(phi_gap_b < PHI_BAND_REL),
+    })
+
+    write_records(out_path, records)
+    ok = all(_bools(records))
+    print(f"wrote {len(records)} records to {out_path}; ok={ok}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(*sys.argv[1:]))

@@ -1,4 +1,4 @@
-"""smklint rules SMK101–SMK119 — the repo's JAX invariants, each one
+"""smklint rules SMK101–SMK120 — the repo's JAX invariants, each one
 traceable to the PR that established it (see analysis/RULES.md).
 
 All rules are pure-AST (no jax import). Shared machinery:
@@ -1464,6 +1464,9 @@ _DURABLE_MODULES = (
     # serving artifacts (ISSUE 14): a torn fit bundle is a torn
     # deployment — same write-to-temp + atomic-rename contract
     "smk_tpu/serve/artifact",
+    # the ingest append log (ISSUE 20): pending batch files are
+    # re-read by restart replay — a torn segment is lost rows
+    "smk_tpu/serve/ingest",
 )
 
 
@@ -2362,6 +2365,124 @@ class GenerationPublicationRule(Rule):
             )
 
 
+# ---------------------------------------------------------------------------
+# SMK120 — engine-dispatch discipline
+# ---------------------------------------------------------------------------
+
+# The dense subset-factor entry points in ops/chol.py. A model-layer
+# call site reaching one of these DIRECTLY has hard-wired the dense
+# engine: under subset_engine="vecchia" the call still builds and
+# factors the full (m, m) block — the exact m^3 wall the sparse
+# engine exists to dodge — while the sampler's OTHER half runs sparse,
+# silently mixing two factorizations of different posteriors.
+# jittered_cholesky is deliberately absent: it is the shared
+# small-block primitive both engines legitimately use.
+_DENSE_FACTOR_FUNCS = (
+    "shifted_cholesky",
+    "batched_shifted_cholesky",
+    "blocked_cholesky",
+)
+
+# The engine-dispatch seam inside models/: the only functions allowed
+# to touch the dense factor entry points, because each one is (or is
+# called under) a site where the engine choice has already been made.
+_ENGINE_SEAM_FUNCS = (
+    "_chol_r",
+    "_shifted_chol_one",
+    "_shifted_chol_stack",
+)
+
+
+class EngineDispatchRule(Rule):
+    id = "SMK120"
+    name = "engine-dispatch-discipline"
+    doc = (
+        "engine dispatch — model-layer code (smk_tpu/models/) may "
+        "not call the dense subset-factor entry points "
+        "(ops.chol.shifted_cholesky / batched_shifted_cholesky / "
+        "blocked_cholesky) except from inside the engine-dispatch "
+        "seam (_chol_r / _shifted_chol_one / _shifted_chol_stack). "
+        "A direct call hard-wires the dense engine: under "
+        "subset_engine='vecchia' it still builds and factors the "
+        "full (m, m) block — the m^3 wall the sparse engine exists "
+        "to avoid — while the rest of the sampler runs sparse, "
+        "mixing two factorizations of different posteriors. Route "
+        "the call through the seam (or dispatch on the engine and "
+        "suppress the dense arm with a justification)."
+    )
+
+    def applies(self, module):
+        return "smk_tpu/models/" in module.norm_path()
+
+    @staticmethod
+    def _factor_aliases(tree) -> dict:
+        """Local names bound to a dense factor entry point by
+        from-import (same alias coverage SMK110/111/113/119 grew)."""
+        out: dict = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.level == 0:
+                if node.module == "smk_tpu.ops.chol":
+                    for a in node.names:
+                        if a.name in _DENSE_FACTOR_FUNCS:
+                            out[a.asname or a.name] = a.name
+        return out
+
+    @staticmethod
+    def _dense_factor_call(node: ast.Call, aliases: dict):
+        chain = attr_chain(node.func)
+        if not chain:
+            return None
+        if len(chain) == 1:
+            return aliases.get(chain[0])
+        # attribute spellings: chol.shifted_cholesky,
+        # ops.chol.shifted_cholesky, smk_tpu.ops.chol.shifted_cholesky
+        if chain[-1] in _DENSE_FACTOR_FUNCS and chain[-2] == "chol":
+            return chain[-1]
+        return None
+
+    def check(self, module, ctx):
+        aliases = self._factor_aliases(module.tree)
+        rule = self
+        found: List[Finding] = []
+
+        # Unlike SMK119's enclosing() (first match = outermost), the
+        # seam check needs the INNERMOST enclosing def: a nested
+        # helper inside a seam function is still the seam, and a
+        # seam-named closure inside a non-seam function is not.
+        class _Walk(ast.NodeVisitor):
+            def __init__(self):
+                self.stack: List[str] = []
+
+            def visit_FunctionDef(self, node):
+                self.stack.append(node.name)
+                self.generic_visit(node)
+                self.stack.pop()
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Call(self, node):
+                target = rule._dense_factor_call(node, aliases)
+                if target is not None:
+                    inner = self.stack[-1] if self.stack else None
+                    if inner not in _ENGINE_SEAM_FUNCS:
+                        found.append(rule.finding(
+                            module, node,
+                            f"direct call to dense factor entry "
+                            f"point '{target}' outside the engine-"
+                            "dispatch seam (_chol_r / "
+                            "_shifted_chol_one / _shifted_chol_stack)"
+                            " — this hard-wires the dense engine and "
+                            "under subset_engine='vecchia' factors "
+                            "the full (m, m) block the sparse engine "
+                            "exists to avoid; route through the seam "
+                            "or dispatch on the engine first",
+                        ))
+                self.generic_visit(node)
+
+        _Walk().visit(module.tree)
+        yield from found
+
+
 ALL_RULES = [
     BatchingRuleRule(),
     HostNondeterminismRule(),
@@ -2382,4 +2503,5 @@ ALL_RULES = [
     DeviceLayoutRule(),
     ScheduleDisciplineRule(),
     GenerationPublicationRule(),
+    EngineDispatchRule(),
 ]

@@ -187,10 +187,14 @@ def chunk_bucket_key(
     lowered for different avals. ``mesh`` appends the topology
     fingerprint (trailing, so key[0]/key[1] stay kind/length — the
     chaos harness contract)."""
-    cov_model, link, fused, n_chains, j = model.program_bucket_fields()
+    (
+        cov_model, link, fused, n_chains, j,
+        engine, n_nbr, build_dt,
+    ) = model.program_bucket_fields()
     return _with_topology((
         kind, length, k, chunk_size, m, q, p, t, d, n_chains, j,
-        cov_model, link, fused, config_digest(model.config),
+        cov_model, link, fused, engine, n_nbr, build_dt,
+        config_digest(model.config),
     ), mesh)
 
 
@@ -199,10 +203,14 @@ def aux_bucket_key(model, kind: str, *shape_fields, mesh=None) -> tuple:
     refork): ``kind`` never collides with the chunk kinds, so the
     chaos harness's chunk-program filter skips these. ``mesh``
     appends the topology fingerprint exactly as on chunk keys."""
-    cov_model, link, fused, n_chains, j = model.program_bucket_fields()
+    (
+        cov_model, link, fused, n_chains, j,
+        engine, n_nbr, build_dt,
+    ) = model.program_bucket_fields()
     return _with_topology(
         (kind,) + tuple(shape_fields)
         + (n_chains, j, cov_model, link, fused,
+           engine, n_nbr, build_dt,
            config_digest(model.config)),
         mesh,
     )

@@ -20,6 +20,10 @@ PARTITION_METHODS = ("random", "coherent")
 LINKS = ("probit", "logit")
 COMBINERS = ("wasserstein_mean", "weiszfeld_median")
 PHI_PROPOSAL_FAMILIES = ("gaussian", "student_t", "mixture")
+
+SUBSET_ENGINES = ("dense", "vecchia")
+
+BUILD_DTYPES = ("float32", "bfloat16")
 CHUNK_PIPELINES = ("sync", "overlap")
 FAULT_POLICIES = ("abort", "quarantine")
 ADAPTIVE_SCHEDULES = ("off", "on")
@@ -329,6 +333,38 @@ class SMKConfig:
     # unavailable the sampler falls back to the XLA path with a
     # one-time warning (ops/pallas_build.resolve_fused_build).
     fused_build: str = "off"
+
+    # Per-subset latent-field engine. "dense" (default) is the
+    # historical path — (m, m) covariance build + dense Cholesky,
+    # O(m^3) flops / O(m^2) HBM per factor — and is BIT-identical to
+    # every prior round (the vecchia sites are not even traced).
+    # "vecchia" lowers each subset posterior to a nearest-neighbor GP
+    # (Vecchia/NNGP) sparse-precision approximation: each site
+    # conditions on its `n_neighbors` nearest predecessors in the
+    # subset's Morton order (ops/vecchia.py), giving O(m * nn^3)
+    # flops and O(m * nn) HBM — the engine that breaks the dense m^3
+    # ceiling (ROADMAP item 5). Chains are statistically equivalent
+    # to dense at matched convergence floors, not bitwise
+    # (scripts/vecchia_probe.py pins the agreement bands). Requires
+    # the scalar conditional phi sampler (phi_sampler="conditional",
+    # phi_proposals=1), u_solver="chol" (the vecchia u-update is its
+    # own preconditioned-CG perturbation solve; the dense cg plumbing
+    # does not apply), and fused_build="off" (the Pallas build tiles
+    # dense (m, m) products that vecchia never forms). Both
+    # subset_engine and n_neighbors ride the compile digest and the
+    # L1/L2 program bucket keys — a warm dense store can never serve
+    # a vecchia ask.
+    subset_engine: str = "dense"
+    n_neighbors: int = 16
+
+    # Covariance-build dtype. "bfloat16" evaluates the correlation
+    # kernels in bf16 and upcasts before every Cholesky/accumulate
+    # (ROADMAP item 5's cheap adjacent experiment — halves build-side
+    # HBM traffic; factor stays fp32). Default "float32" is
+    # trace-identical to the historical build. Requires
+    # fused_build="off" (the Pallas kernels have their own dtype
+    # story). Rides the digest and bucket keys like subset_engine.
+    build_dtype: str = "float32"
 
     # Chunked-executor host pipeline (parallel/recovery.py
     # fit_subsets_chunked / fit_subsets_checkpointed):
@@ -654,6 +690,7 @@ class SMKConfig:
         "trisolve_block_size", "pg_n_terms", "phi_proposals",
         "fault_max_retries", "dist_init_retries",
         "adapt_patience", "min_samples_before_stop",
+        "n_neighbors",
     )
 
     def __post_init__(self):
@@ -729,6 +766,44 @@ class SMKConfig:
             raise ValueError(
                 "fused_build must be 'off' or 'pallas'"
             )
+        if self.subset_engine not in SUBSET_ENGINES:
+            raise ValueError(
+                f"subset_engine must be one of {SUBSET_ENGINES}"
+            )
+        if self.n_neighbors < 1:
+            raise ValueError("n_neighbors must be >= 1")
+        if self.build_dtype not in BUILD_DTYPES:
+            raise ValueError(
+                f"build_dtype must be one of {BUILD_DTYPES}"
+            )
+        if self.build_dtype == "bfloat16" and self.fused_build != "off":
+            raise ValueError(
+                "build_dtype='bfloat16' requires fused_build='off' — "
+                "the Pallas build kernels carry their own dtype story"
+            )
+        if self.subset_engine == "vecchia":
+            if self.phi_sampler != "conditional":
+                raise ValueError(
+                    "subset_engine='vecchia' requires "
+                    "phi_sampler='conditional' — the collapsed/MTM "
+                    "engine factors dense candidate stacks"
+                )
+            if self.phi_proposals != 1:
+                raise ValueError(
+                    "subset_engine='vecchia' requires phi_proposals=1"
+                )
+            if self.fused_build != "off":
+                raise ValueError(
+                    "subset_engine='vecchia' requires "
+                    "fused_build='off' — the fused kernels tile dense "
+                    "(m, m) builds that vecchia never forms"
+                )
+            if self.u_solver != "chol":
+                raise ValueError(
+                    "subset_engine='vecchia' requires u_solver='chol' "
+                    "— the vecchia u-update is its own preconditioned-"
+                    "CG perturbation solve"
+                )
         if self.chunk_pipeline not in CHUNK_PIPELINES:
             raise ValueError(
                 f"chunk_pipeline must be one of {CHUNK_PIPELINES}"

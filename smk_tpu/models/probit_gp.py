@@ -95,6 +95,14 @@ from smk_tpu.ops.pallas_build import (
     resolve_fused_build,
 )
 from smk_tpu.ops.polya_gamma import sample_pg
+from smk_tpu.ops.vecchia import (
+    build_neighbor_consts,
+    build_test_neighbor_consts,
+    vecchia_coeffs,
+    vecchia_krige_draw,
+    vecchia_loglik,
+    vecchia_posterior_draw,
+)
 from smk_tpu.ops.quantiles import quantile_grid
 from smk_tpu.ops.truncnorm import sample_albert_chib_latent
 from smk_tpu.utils.tracing import mtm_chol_scope
@@ -155,6 +163,16 @@ class BuildConsts(NamedTuple):
     dist_test: Optional[jnp.ndarray]  # (t, t) test pairwise
     coords: Optional[jnp.ndarray]  # (m, d) — fused path only
     coords_test: Optional[jnp.ndarray]  # (t, d) — fused path only
+    # vecchia engine only (ops/vecchia.py): per-site neighbor sets
+    # over the Morton-ordered subset and their block distances —
+    # O(m * nn) geometry replacing the (m, m)/(m, t)/(t, t) dense
+    # matrices above (all five stay None under the vecchia engine).
+    nbr_idx: Optional[jnp.ndarray] = None  # (m, nn) int32
+    nbr_dist: Optional[jnp.ndarray] = None  # (m, nn+1, nn+1)
+    nbr_valid: Optional[jnp.ndarray] = None  # (m, nn)
+    tnbr_idx: Optional[jnp.ndarray] = None  # (t, nn) int32
+    tnbr_dist: Optional[jnp.ndarray] = None  # (t, nn+1, nn+1)
+    tnbr_valid: Optional[jnp.ndarray] = None  # (t, nn)
 
 
 class SamplerState(NamedTuple):
@@ -165,7 +183,15 @@ class SamplerState(NamedTuple):
     a: jnp.ndarray  # (q, q) lower-triangular coregionalization
     phi: jnp.ndarray  # (q,)
     chol_r: jnp.ndarray  # (q, m, m) Cholesky of R(phi) — carried so the
-    # phi-MH step factors only the proposal, not the current state
+    # phi-MH step factors only the proposal, not the current state.
+    # Under subset_engine="vecchia" this field instead carries the
+    # PACKED sparse-precision coefficients (q, m, nn+1) — columns
+    # [0:nn] the per-site neighbor coefficients b, column nn the
+    # conditional std d (ops/vecchia.py vecchia_coeffs). Same carry
+    # contract (phi-only, refreshed on acceptance), same pytree field
+    # name, so the chunked executor, checkpointing and sharding
+    # consume it unchanged (recovery._finite_subsets deliberately
+    # never inspects chol_r).
     key: jax.Array
     phi_accept: jnp.ndarray  # (q,) running acceptance count
     phi_log_step: jnp.ndarray  # (q,) log MH step — Robbins–Monro
@@ -308,20 +334,34 @@ class SpatialGPSampler:
         # bit-identically (the fused sites do not exist in its jaxpr).
         self.fused_build = resolve_fused_build(config.fused_build)
         self._fused = self.fused_build == "pallas"
+        # Static engine dispatch: "dense" traces the HISTORICAL
+        # program bit-identically (no vecchia site exists in its
+        # jaxpr); "vecchia" swaps the (m, m) build + m^3 factor for
+        # the sparse-precision path (ops/vecchia.py) behind the same
+        # Gibbs step contract. config validation already pinned the
+        # engine's required knobs (conditional phi, u_solver="chol",
+        # fused off).
+        self._vecchia = config.subset_engine == "vecchia"
 
     def program_bucket_fields(self) -> tuple:
         """The model-identity fields of every compiled-program bucket
         key (smk_tpu/compile/programs.py): ``(cov_model, link,
-        resolved_fused_build, n_chains, phi_proposals)``. The fused
+        resolved_fused_build, n_chains, phi_proposals,
+        subset_engine, n_neighbors, build_dtype)``. The fused
         mode is the RESOLVED one — a config asking for "pallas" on a
         backend that fell back to the XLA path traces a different
         program, and an AOT store keyed on the request would hand the
         wrong executable across environments (the same
-        resolved-not-requested rule bench records follow)."""
+        resolved-not-requested rule bench records follow). The
+        engine triplet rides the key for the same reason the digest
+        carries it: a warm dense store must MISS on a vecchia (or
+        bf16-build, or different-nn) ask — the traced programs are
+        structurally different."""
         cfg = self.config
         return (
             cfg.cov_model, cfg.link, self.fused_build,
             cfg.n_chains, cfg.phi_proposals,
+            cfg.subset_engine, cfg.n_neighbors, cfg.build_dtype,
         )
 
     # ------------------------------------------------------------------
@@ -330,6 +370,24 @@ class SpatialGPSampler:
     # XLA expression VERBATIM on the "off" path (golden chains are
     # bitwise-pinned) and routes to ops/pallas_build.py when fused.
     # ------------------------------------------------------------------
+    def _corr(self, dist, phi):
+        """Correlation kernel evaluation under the build-dtype gate.
+        "float32" (default) is the literal historical expression —
+        golden chains stay bitwise. "bfloat16" evaluates the kernel
+        elementwise math in bf16 and upcasts the result: the build's
+        HBM write (and the distance read) go half-width while every
+        downstream Cholesky/solve/accumulate stays fp32 (ROADMAP
+        item 5's adjacent experiment; parity leg in
+        scripts/vecchia_probe.py)."""
+        cfg = self.config
+        if cfg.build_dtype == "bfloat16":
+            return correlation(
+                dist.astype(jnp.bfloat16),
+                phi.astype(jnp.bfloat16),
+                cfg.cov_model,
+            ).astype(dist.dtype)
+        return correlation(dist, phi, cfg.cov_model)
+
     def _masked_corr_stack(self, consts, phis, mask):
         """(s, m, m) masked correlation stack for an (s,) phi vector
         (the conditional proposal batch, the CG operator rebuild).
@@ -339,8 +397,11 @@ class SpatialGPSampler:
             return fused_masked_correlation_stack(
                 consts.coords, phis, mask, self.config.cov_model
             )
-        return masked_correlation_stack(
-            consts.dist, phis, mask, self.config.cov_model
+        # == masked_correlation_stack under the build-dtype gate
+        # (correlation_stack is literally this broadcast; float32 is
+        # trace-identical to the historical call)
+        return _pad_identity(
+            self._corr(consts.dist[None], phis[:, None, None]), mask
         )
 
     def _masked_corr_one(self, consts, phi, mask):
@@ -351,9 +412,7 @@ class SpatialGPSampler:
                 consts.coords, jnp.reshape(phi, (1,)), mask,
                 self.config.cov_model,
             )[0]
-        return masked_correlation(
-            consts.dist, phi, mask, self.config.cov_model
-        )
+        return _pad_identity(self._corr(consts.dist, phi), mask)
 
     def _shifted_chol_stack(self, consts, phis, mask, shift):
         """(chol_stack, r_stack) for S = R~(phi_k) + diag(shift), the
@@ -369,9 +428,7 @@ class SpatialGPSampler:
                 self.config.cov_model,
             )
             return jnp.tril(lax.linalg.cholesky(s_stk)), None
-        r_stk = masked_correlation_stack(
-            consts.dist, phis, mask, self.config.cov_model
-        )
+        r_stk = self._masked_corr_stack(consts, phis, mask)
         return batched_shifted_cholesky(r_stk, shift), r_stk
 
     def _shifted_chol_one(self, consts, phi, mask, shift):
@@ -388,9 +445,7 @@ class SpatialGPSampler:
                 self.config.cov_model,
             )[0]
             return jnp.tril(lax.linalg.cholesky(s_mat)), s_mat, None
-        r = masked_correlation(
-            consts.dist, phi, mask, self.config.cov_model
-        )
+        r = self._masked_corr_one(consts, phi, mask)
         return shifted_cholesky(r, shift), None, r
 
     def _chol_r(self, r: jnp.ndarray) -> jnp.ndarray:
@@ -464,13 +519,11 @@ class SpatialGPSampler:
                 consts.coords_test, phi, cfg.cov_model
             )  # (q, t, t)
         else:
-            r_cross = mask[None, :, None] * correlation(
-                consts.dist_cross[None], phi[:, None, None],
-                cfg.cov_model,
+            r_cross = mask[None, :, None] * self._corr(
+                consts.dist_cross[None], phi[:, None, None]
             )  # (q, m, t)
-            r_test = correlation(
-                consts.dist_test[None], phi[:, None, None],
-                cfg.cov_model,
+            r_test = self._corr(
+                consts.dist_test[None], phi[:, None, None]
             )  # (q, t, t)
         return r_cross, r_test
 
@@ -542,6 +595,17 @@ class SpatialGPSampler:
         the kriging operators from ``consts``' cross/test geometry —
         burn-in scans never pay for or carry them."""
         cfg = self.config
+        if self._vecchia:
+            # The vecchia engine carries no dense operators at all —
+            # its u-update is a Jacobi-preconditioned CG on the
+            # O(m * nn) sparse precision and its kriging recomputes
+            # the (t, nn+1) test coefficients per kept draw (both in
+            # ops/vecchia.py). Only the factorization counters ride.
+            return FactorCache(
+                r_mv=None, nys_z=None, chol_inv=None,
+                krige_w=None, krige_chol=None,
+                n_chol=empty_counter(), n_chol_calls=empty_counter(),
+            )
         r_mv = nys_z = chol_inv = krige_w = krige_chol = None
         if cfg.u_solver == "cg":
             r_full = self._masked_corr_stack(consts, state.phi, mask)
@@ -580,22 +644,40 @@ class SpatialGPSampler:
         phi0 = jnp.full((q,), 3.0 / 0.5, dtype)
         lo, hi = self.config.priors.phi_min, self.config.priors.phi_max
         phi0 = jnp.clip(phi0, lo + 1e-3 * (hi - lo), hi - 1e-3 * (hi - lo))
-        if self._fused:
-            r0 = fused_masked_correlation_stack(
-                data.coords, phi0, data.mask, self.config.cov_model
+        if self._vecchia:
+            # chol_r carries the PACKED vecchia coefficients at phi0
+            # (q, m, nn+1) — built from the same neighbor geometry
+            # _consts freezes (build_neighbor_consts is deterministic
+            # in (coords, mask, nn), so both sites agree exactly).
+            cfg = self.config
+            nbr_idx, nbr_dist, nbr_valid = build_neighbor_consts(
+                data.coords, data.mask, cfg.n_neighbors
             )
+            jit_eff = cfg.effective_jitter(m)
+            chol0 = jax.vmap(
+                lambda ph: vecchia_coeffs(
+                    nbr_dist, nbr_valid, ph, jit_eff,
+                    cfg.cov_model, cfg.build_dtype,
+                )
+            )(phi0)
         else:
-            dist = pairwise_distance(data.coords)
-            r0 = masked_correlation(
-                dist[None], phi0[:, None, None], data.mask,
-                self.config.cov_model,
-            )
+            if self._fused:
+                r0 = fused_masked_correlation_stack(
+                    data.coords, phi0, data.mask, self.config.cov_model
+                )
+            else:
+                dist = pairwise_distance(data.coords)
+                r0 = _pad_identity(
+                    self._corr(dist[None], phi0[:, None, None]),
+                    data.mask,
+                )
+            chol0 = self._chol_r(r0)
         return SamplerState(
             beta=beta_init.astype(dtype),
             u=jnp.zeros((m, q), dtype),
             a=jnp.eye(q, dtype=dtype),
             phi=phi0,
-            chol_r=self._chol_r(r0),
+            chol_r=chol0,
             key=key,
             phi_accept=jnp.zeros((q,), dtype),
             phi_log_step=jnp.full(
@@ -770,17 +852,70 @@ class SpatialGPSampler:
                 cache_new,
             )
 
+        def phi_mh_vecchia(_):
+            # Same move as phi_mh — logit-scale random walk, same key
+            # split inventory, same Robbins–Monro schedule — with the
+            # O(q m^3) proposal factorization replaced by the batched
+            # (m, nn, nn) coefficient build and the trisolve loglik by
+            # the O(m * nn) sparse residual form (ops/vecchia.py).
+            # The pad sites' phi-free (b = 0, d = sqrt(1+jit)) terms
+            # cancel in the ratio exactly like the dense pad-identity
+            # rows do.
+            step = jnp.exp(state.phi_log_step)
+            t_cur = jnp.log((phi - lo) / (hi - phi))
+            t_prop = t_cur + step * jax.random.normal(
+                kprop, (q,), dtype
+            )
+            sig_cur = jax.nn.sigmoid(t_cur)
+            sig_prop = jax.nn.sigmoid(t_prop)
+            phi_prop = lo + (hi - lo) * sig_prop
+            log_jac_cur = jnp.log(sig_cur * (1.0 - sig_cur))
+            log_jac_prop = jnp.log(sig_prop * (1.0 - sig_prop))
+            with jax.named_scope("phi_vecchia_coeffs"):
+                packed_prop = jax.vmap(
+                    lambda ph: vecchia_coeffs(
+                        consts.nbr_dist, consts.nbr_valid, ph,
+                        jit_eff, cfg.cov_model, cfg.build_dtype,
+                    )
+                )(phi_prop)
+            cache2 = tick(cache, q, n_calls=1)  # ONE batched
+            # (q*m, nn, nn) coefficient-factor call, q logical builds
+
+            def v_loglik(packed):
+                return jax.vmap(
+                    vecchia_loglik, in_axes=(0, None, 1)
+                )(packed, consts.nbr_idx, u)  # (q,)
+
+            log_ratio = (
+                v_loglik(packed_prop)
+                + log_jac_prop
+                - v_loglik(state.chol_r)
+                - log_jac_cur
+            )
+            accept = jnp.log(
+                jax.random.uniform(kphi, (q,), dtype, minval=1e-12)
+            ) < log_ratio
+            return (
+                jnp.where(accept, phi_prop, phi),
+                jnp.where(
+                    accept[:, None, None], packed_prop, state.chol_r
+                ),
+                accept.astype(dtype),
+                cache2,
+            )
+
         def phi_keep(_):
             return phi, state.chol_r, jnp.zeros((q,), dtype), cache
 
         if cfg.phi_sampler == "conditional":
+            phi_fn = phi_mh_vecchia if self._vecchia else phi_mh
             if cfg.phi_update_every == 1:
                 is_update = jnp.asarray(1.0, dtype)
-                phi, chol_r, accepted, cache = phi_mh(None)
+                phi, chol_r, accepted, cache = phi_fn(None)
             else:
                 is_update = (it % cfg.phi_update_every == 0).astype(dtype)
                 phi, chol_r, accepted, cache = lax.cond(
-                    it % cfg.phi_update_every == 0, phi_mh, phi_keep,
+                    it % cfg.phi_update_every == 0, phi_fn, phi_keep,
                     None,
                 )
         else:  # collapsed: updated per component inside the u loop
@@ -1211,6 +1346,24 @@ class SpatialGPSampler:
                 )
                 accepted = accepted.at[j].set(acc_j)
             l_j = chol_r[j]
+            if self._vecchia:
+                # l_j holds the PACKED coefficients (m, nn+1).
+                # Perturbation-optimization draw from the exact
+                # conditional N(P^{-1} b, P^{-1}), P = Q + diag(c) —
+                # every matvec O(m * nn), no m x m operator exists
+                # (ops/vecchia.py vecchia_posterior_draw). The two
+                # normal draws consume the same (ku_p, ku_n) stream
+                # slots the dense Matheron draw uses.
+                with jax.named_scope("u_vecchia_solve"):
+                    u = u.at[:, j].set(
+                        vecchia_posterior_draw(
+                            l_j, consts.nbr_idx, b_vec, c_safe,
+                            jax.random.normal(ku_p, (m,), dtype),
+                            jax.random.normal(ku_n, (m,), dtype),
+                            cfg.cg_iters,
+                        )
+                    )
+                return (phi, chol_r, cache, u, accepted), None
             # prior draw u* = L xi  and noise draw eta* = sqrt(d) xi2
             u_star = l_j @ jax.random.normal(ku_p, (m,), dtype)
             eta_star = jnp.sqrt(d_vec) * jax.random.normal(
@@ -1289,10 +1442,9 @@ class SpatialGPSampler:
                         u_star + r0 @ s + jit_eff * s
                     )
                 else:
-                    r0 = masked_correlation(
-                        dist, phi[j], mask, cfg.cov_model
-                    )
+                    r0 = self._masked_corr_one(consts, phi[j], mask)
                     if chol_s is None:
+                        # smklint: disable=SMK120 -- the dense engine's own u-draw factorization: vecchia dispatched (and returned) above, so this IS the dense arm of the engine seam
                         chol_s = shifted_cholesky(r0, jit_eff + d_vec)
                         cache = tick(cache, 1)
                     s = chol_solve(chol_s, rhs_vec)
@@ -1395,7 +1547,28 @@ class SpatialGPSampler:
         # prior-only noise and must not leak into the test sites.
         t_test = data.coords_test.shape[0]
         kpred_q = jax.random.split(kpred, q)
-        if cache.krige_w is not None:
+        if self._vecchia:
+            # Nearest-neighbor kriging: each test site conditions on
+            # its nn nearest OBSERVED sites (consts.tnbr_*) — the
+            # (t, nn+1) coefficient build at the current phi is
+            # O(t * nn^3), trivial per kept draw, so nothing is
+            # cached. Draws are conditionally independent across test
+            # sites given u (the marginal-variance contract — see the
+            # README accuracy caveat vs the dense joint draw).
+            with jax.named_scope("krige_vecchia"):
+
+                def vkrige(ph_j, u_j, key_j):
+                    tpacked = vecchia_coeffs(
+                        consts.tnbr_dist, consts.tnbr_valid, ph_j,
+                        jit_eff, cfg.cov_model, cfg.build_dtype,
+                    )
+                    z = jax.random.normal(key_j, (t_test,), dtype)
+                    return vecchia_krige_draw(
+                        tpacked, consts.tnbr_idx, u_j, z
+                    )
+
+                u_star_test = jax.vmap(vkrige)(phi, u.T, kpred_q)
+        elif cache.krige_w is not None:
             # cached-operator path: W = R^{-1} R_c and chol(cond_cov)
             # are phi-only and carried in the FactorCache (refreshed on
             # phi acceptance), so each kept draw is one (t, m) GEMV +
@@ -1517,6 +1690,29 @@ class SpatialGPSampler:
         # fused path carries the raw coordinates INSTEAD of the
         # precomputed distance matrices — the Pallas kernels
         # recompute distance in-tile, so the (m, m) dist never exists.
+        # The vecchia engine carries the frozen neighbor geometry
+        # instead — per-site neighbor indices, block distances and
+        # validity for both the training sites (predecessor sets) and
+        # the test sites (NN kriging); the dense distance matrices
+        # stay None (the (m, m) candidate matrix inside the build is
+        # a transient).
+        if self._vecchia:
+            cfg = self.config
+            nbr_idx, nbr_dist, nbr_valid = build_neighbor_consts(
+                data.coords, data.mask, cfg.n_neighbors
+            )
+            tnbr_idx, tnbr_dist, tnbr_valid = (
+                build_test_neighbor_consts(
+                    data.coords, data.mask, data.coords_test,
+                    cfg.n_neighbors,
+                )
+            )
+            return BuildConsts(
+                None, None, None, None, None,
+                nbr_idx=nbr_idx, nbr_dist=nbr_dist,
+                nbr_valid=nbr_valid, tnbr_idx=tnbr_idx,
+                tnbr_dist=tnbr_dist, tnbr_valid=tnbr_valid,
+            )
         if self._fused:
             return BuildConsts(
                 None, None, None, data.coords, data.coords_test

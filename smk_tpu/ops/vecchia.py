@@ -1,0 +1,269 @@
+"""Vecchia / NNGP sparse-precision subset engine primitives.
+
+The dense subset engine pays O(m^2) HBM and O(m^3) flops per factor —
+the reason the m-ladder saturates near ~4k (ROADMAP item 5). The
+Vecchia approximation conditions each site on at most ``nn``
+*predecessors* in a fixed ordering, which factors the subset precision
+as Q = F^T F with F = D^{-1}(I - B) unit-sparse: B holds per-site
+neighbor coefficients (m, nn) and D the conditional standard
+deviations (m,). Everything here is O(m * nn^3) flops and O(m * nn)
+HBM — one vmapped (nn, nn) Cholesky per site instead of one (m, m)
+factor.
+
+Ordering matters for NNGP quality: neighbors must be *near* in space.
+The coherent partition (parallel/partition.py) already Morton-orders
+rows within each subset, so the natural index order is a
+space-filling-curve order and predecessor sets are genuinely local —
+we reuse that ordering verbatim rather than re-sorting.
+
+Masking law (the single invariant every function here leans on):
+invalid neighbor slots — slots past a site's predecessor count, slots
+pointing at padded rows, and every slot of a padded site — carry
+coefficient b == 0 and are replaced by identity rows/cols in the
+(nn, nn) conditioning block, so a padded site degenerates to the same
+unit-variance pseudo-prior the dense engine's pad-identity R~ gives it
+(d = sqrt(1 + jitter), phi-free, cancelling in MH ratios). Distances
+of invalid candidates are set to the *finite* ``LARGE`` (never inf:
+inf * 0 = nan under the masking arithmetic) and validity is recovered
+as dist < LARGE / 2.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from smk_tpu.ops.distance import cross_distance, pairwise_distance
+from smk_tpu.ops.kernels import correlation
+
+# Finite sentinel for masked-out candidate distances. exp(-phi * 1e10)
+# underflows to exactly 0.0 in float32 for every admissible phi, so a
+# masked slot's raw correlation is exactly zero even before the
+# validity masking zeroes its coefficient.
+LARGE = 1e10
+
+# Conditional-variance floor: dvar = (1 + jit) - alpha'alpha is
+# mathematically positive but can round below zero for near-duplicate
+# sites; the floor keeps d finite and the loglik well-defined.
+_DVAR_FLOOR = 1e-10
+
+
+def build_neighbor_consts(
+    coords: jnp.ndarray, mask: jnp.ndarray, nn: int
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Per-site predecessor neighbor sets over one padded subset.
+
+    coords: (m, d) padded subset coordinates (Morton order within the
+    subset — see coherent_assignments); mask: (m,) 1.0 real / 0.0 pad.
+
+    Returns (nbr_idx, nbr_dist, nbr_valid):
+      nbr_idx  (m, nn)  int32 — indices of the nn nearest *valid
+                predecessors* of each site (arbitrary in-range values
+                at invalid slots; their coefficients are zeroed).
+      nbr_dist (m, nn+1, nn+1) — pairwise distances of the block
+                [neighbors..., site]; garbage at invalid slots, which
+                the identity masking in vecchia_coeffs discards.
+      nbr_valid (m, nn) — 1.0 where the slot holds a real neighbor.
+
+    The (m, m) candidate distance matrix is a transient — it never
+    reaches HBM-resident state, matching the O(m * nn) footprint
+    claim for everything the sampler carries.
+    """
+    m = coords.shape[0]
+    dist = pairwise_distance(coords)
+    valid = mask > 0
+    idx = jnp.arange(m)
+    predecessor = idx[None, :] < idx[:, None]
+    cand_ok = predecessor & valid[None, :]
+    cand = jnp.where(cand_ok, dist, LARGE)
+    neg_d, nbr_idx = lax.top_k(-cand, nn)
+    nbr_d = -neg_d
+    nbr_valid = ((nbr_d < LARGE / 2) & valid[:, None]).astype(coords.dtype)
+    pts = jnp.concatenate([coords[nbr_idx], coords[:, None, :]], axis=1)
+    nbr_dist = jax.vmap(pairwise_distance)(pts)
+    return nbr_idx.astype(jnp.int32), nbr_dist, nbr_valid
+
+
+def build_test_neighbor_consts(
+    coords: jnp.ndarray,
+    mask: jnp.ndarray,
+    coords_test: jnp.ndarray,
+    nn: int,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Nearest *observed* neighbor sets for test sites (NN kriging).
+
+    Unlike training sites, test sites condition on the full observed
+    subset (no predecessor restriction — prediction composes after the
+    fit, so every real row is admissible).
+
+    Returns (tnbr_idx (t, nn) int32, tnbr_dist (t, nn+1, nn+1),
+    tnbr_valid (t, nn)) with the same masking law as
+    build_neighbor_consts.
+    """
+    cd = cross_distance(coords_test, coords)
+    cand = jnp.where(mask[None, :] > 0, cd, LARGE)
+    neg_d, tnbr_idx = lax.top_k(-cand, nn)
+    tnbr_d = -neg_d
+    tnbr_valid = (tnbr_d < LARGE / 2).astype(coords.dtype)
+    pts = jnp.concatenate(
+        [coords[tnbr_idx], coords_test[:, None, :]], axis=1
+    )
+    tnbr_dist = jax.vmap(pairwise_distance)(pts)
+    return tnbr_idx.astype(jnp.int32), tnbr_dist, tnbr_valid
+
+
+def vecchia_coeffs(
+    nbr_dist: jnp.ndarray,
+    nbr_valid: jnp.ndarray,
+    phi: jnp.ndarray,
+    jitter: float,
+    model: str,
+    build_dtype: str = "float32",
+) -> jnp.ndarray:
+    """Packed Vecchia coefficients for one decay value.
+
+    nbr_dist: (m, nn+1, nn+1) block distances [neighbors..., site];
+    nbr_valid: (m, nn); phi: scalar. Returns packed (m, nn+1):
+    columns [0:nn] are the conditional-mean coefficients b (zero at
+    invalid slots), column nn is the conditional standard deviation d.
+
+    Per site: C = corr(N, N) + jit*I (invalid rows/cols -> identity),
+    c = corr(N, site) (invalid -> 0), alpha = L^{-1} c,
+    b = L^{-T} alpha, d = sqrt((1 + jit) - alpha'alpha).
+
+    build_dtype == "bfloat16" evaluates the correlation kernel in
+    bf16 and upcasts before the Cholesky — build in bf16, factor and
+    accumulate in fp32 (the ROADMAP item 5 experiment). The default
+    "float32" path is trace-identical to calling `correlation`
+    directly.
+    """
+    nn = nbr_valid.shape[-1]
+    if build_dtype == "bfloat16":
+        corr = correlation(
+            nbr_dist.astype(jnp.bfloat16), phi.astype(jnp.bfloat16), model
+        ).astype(nbr_dist.dtype)
+    else:
+        corr = correlation(nbr_dist, phi, model)
+    c_nn = corr[:, :nn, :nn]
+    c_site = corr[:, :nn, nn] * nbr_valid
+    vv = nbr_valid[:, :, None] * nbr_valid[:, None, :]
+    eye = jnp.eye(nn, dtype=corr.dtype)
+    c_nn = vv * c_nn + (1.0 - vv) * eye + jitter * eye
+    chol = jnp.linalg.cholesky(c_nn)
+    alpha = jax.scipy.linalg.solve_triangular(
+        chol, c_site[..., None], lower=True
+    )
+    b = jax.scipy.linalg.solve_triangular(
+        chol, alpha, lower=True, trans=1
+    )[..., 0]
+    b = b * nbr_valid
+    dvar = (1.0 + jitter) - jnp.sum(alpha[..., 0] ** 2, axis=-1)
+    d = jnp.sqrt(jnp.maximum(dvar, _DVAR_FLOOR))
+    return jnp.concatenate([b, d[:, None]], axis=1)
+
+
+def unpack_coeffs(packed: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Split packed (m, nn+1) coefficients into (b (m, nn), d (m,))."""
+    return packed[..., :-1], packed[..., -1]
+
+
+def vecchia_loglik(
+    packed: jnp.ndarray, nbr_idx: jnp.ndarray, u: jnp.ndarray
+) -> jnp.ndarray:
+    """log N(u | 0, Q^{-1}) up to the phi-free additive constant.
+
+    Per site: -0.5 * ((u_i - b_i . u_{N(i)}) / d_i)^2 - log d_i.
+    Padded sites contribute a phi-free term (b = 0, d = sqrt(1+jit))
+    that cancels in MH ratios, mirroring the dense pad-identity R~.
+    """
+    b, d = unpack_coeffs(packed)
+    resid = (u - jnp.sum(b * u[nbr_idx], axis=-1)) / d
+    return -0.5 * jnp.sum(resid * resid) - jnp.sum(jnp.log(d))
+
+
+def vecchia_f_matvec(
+    packed: jnp.ndarray, nbr_idx: jnp.ndarray, v: jnp.ndarray
+) -> jnp.ndarray:
+    """F v with F = D^{-1}(I - B): (v_i - b_i . v_{N(i)}) / d_i."""
+    b, d = unpack_coeffs(packed)
+    return (v - jnp.sum(b * v[nbr_idx], axis=-1)) / d
+
+
+def vecchia_ft_matvec(
+    packed: jnp.ndarray, nbr_idx: jnp.ndarray, w: jnp.ndarray
+) -> jnp.ndarray:
+    """F^T w — the scatter-add adjoint of vecchia_f_matvec."""
+    b, d = unpack_coeffs(packed)
+    wd = w / d
+    return wd.at[nbr_idx].add(-(b * wd[:, None]))
+
+
+def vecchia_q_matvec(
+    packed: jnp.ndarray, nbr_idx: jnp.ndarray, v: jnp.ndarray
+) -> jnp.ndarray:
+    """Q v = F^T (F v) — the sparse precision applied in O(m * nn)."""
+    return vecchia_ft_matvec(
+        packed, nbr_idx, vecchia_f_matvec(packed, nbr_idx, v)
+    )
+
+
+def vecchia_q_diag(
+    packed: jnp.ndarray, nbr_idx: jnp.ndarray
+) -> jnp.ndarray:
+    """diag(Q) = 1/d_i^2 + sum over sites i with j in N(i) of
+    (b_is / d_i)^2 — the Jacobi preconditioner for posterior CG."""
+    b, d = unpack_coeffs(packed)
+    dq = 1.0 / (d * d)
+    return dq.at[nbr_idx].add((b / d[:, None]) ** 2)
+
+
+def vecchia_posterior_draw(
+    packed: jnp.ndarray,
+    nbr_idx: jnp.ndarray,
+    b_vec: jnp.ndarray,
+    c_safe: jnp.ndarray,
+    eps_prior: jnp.ndarray,
+    eps_noise: jnp.ndarray,
+    cg_iters: int,
+) -> jnp.ndarray:
+    """One exact-in-the-limit draw from N(P^{-1} b_vec, P^{-1}) with
+    P = Q + diag(c_safe) via perturbation optimization.
+
+    rhs = b_vec + F^T eps_prior + sqrt(c_safe) * eps_noise has
+    covariance F^T F + diag(c_safe) = P, so u = P^{-1} rhs has mean
+    P^{-1} b_vec and covariance P^{-1}. The solve is Jacobi-
+    preconditioned CG with the O(m * nn) Q matvec — no dense (m, m)
+    operator is ever materialized.
+    """
+    from smk_tpu.ops.cg import cg_solve
+
+    rhs = (
+        b_vec
+        + vecchia_ft_matvec(packed, nbr_idx, eps_prior)
+        + jnp.sqrt(c_safe) * eps_noise
+    )
+
+    def matvec(v):
+        return vecchia_q_matvec(packed, nbr_idx, v) + c_safe * v
+
+    diag = vecchia_q_diag(packed, nbr_idx) + c_safe
+    return cg_solve(matvec, rhs, cg_iters, diag=diag)
+
+
+def vecchia_krige_draw(
+    tpacked: jnp.ndarray,
+    tnbr_idx: jnp.ndarray,
+    u: jnp.ndarray,
+    z: jnp.ndarray,
+) -> jnp.ndarray:
+    """Nearest-neighbor kriging draw at test sites.
+
+    tpacked: (t, nn+1) test-site coefficients (vecchia_coeffs on the
+    test blocks); u: (m,) latent field draw; z: (t,) standard normals.
+    Per test site: mean = b . u_{N(site)}, draw = mean + d * z —
+    conditional on its own neighbor set, independent across test
+    sites (the marginal-variance contract; see README caveat).
+    """
+    b, d = unpack_coeffs(tpacked)
+    return jnp.sum(b * u[tnbr_idx], axis=-1) + d * z

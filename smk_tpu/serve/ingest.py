@@ -39,6 +39,7 @@ and the ``BENCH_INGEST=1`` rung.
 
 from __future__ import annotations
 
+import os
 from typing import NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
@@ -48,7 +49,23 @@ from smk_tpu.serve.artifact import (
     load_current_generation,
     publish_generation,
 )
+from smk_tpu.utils.checkpoint import _atomic_savez
 from smk_tpu.utils.tracing import monotonic
+
+# Durable append log (ROADMAP item 2 leftover): every ingested batch
+# is persisted as <gen_dir>/pending/batch.<seq>.npz BEFORE the receipt
+# returns (write-to-temp + atomic-rename — the SMK113 contract), so a
+# process death between generations can no longer lose un-refit rows.
+# A batch file lives until its rows ride a COMMITTED generation: refit
+# stamps the highest contiguously-consumed sequence number into the
+# generation manifest ("ingest_watermark") and only then deletes the
+# consumed files — the commit is the durability handoff. A restarted
+# LiveFit (same gen_dir) replays the surviving files after its base
+# fit: files at or below the committed watermark are dropped (their
+# rows live in the served lineage), the rest re-route and re-dirty
+# their subsets so the next refit folds them in.
+_PENDING_DIR = "pending"
+_PENDING_FMT = "batch.%08d.npz"
 
 
 class IngestError(ValueError):
@@ -223,6 +240,10 @@ class LiveFit:
                 "reused_subsets_total": 0,
                 "refit_subsets_total": 0,
                 "generation": None,
+                "pending_persisted": 0,
+                "replayed_batches": 0,
+                "replayed_rows": 0,
+                "ingest_watermark": -1,
             }
         self._model = None
         self._y = self._x = self._coords = None
@@ -231,6 +252,12 @@ class LiveFit:
         self._subset_results = None  # SubsetResult of np arrays, K-leading
         self._param_grid = None  # previous combined grid (warm start)
         self._dirty: set = set()
+        # Append log bookkeeping: (seq, routed-subsets) per live batch
+        # file, the next sequence number, and the highest watermark
+        # already committed to a generation manifest.
+        self._pending: list = []
+        self._pending_seq: int = 0
+        self._watermark: int = -1
         self._full_fit_wall: Optional[float] = None
         self._run_log = None
         if getattr(config, "run_log_dir", None):
@@ -342,6 +369,125 @@ class LiveFit:
                     "subset's next re-fit)"
                 )
         return y, x, c
+
+    # -- durable append log --------------------------------------------
+
+    def _pending_path(self, seq: int) -> str:
+        return os.path.join(
+            self.gen_dir, _PENDING_DIR, _PENDING_FMT % seq
+        )
+
+    def _persist_batch(self, y, x, c) -> int:
+        """Durably persist one validated batch before its receipt is
+        returned; the atomic-rename seam means a reader never sees a
+        torn file."""
+        seq = self._pending_seq
+        self._pending_seq = seq + 1
+        os.makedirs(
+            os.path.join(self.gen_dir, _PENDING_DIR), exist_ok=True
+        )
+        _atomic_savez(
+            self._pending_path(seq), {"y": y, "x": x, "coords": c}
+        )
+        return seq
+
+    def _scan_pending(self):
+        """Sorted (seq, path) of the batch files surviving on disk."""
+        pend = os.path.join(self.gen_dir, _PENDING_DIR)
+        if not os.path.isdir(pend):
+            return []
+        out = []
+        for name in os.listdir(pend):
+            if not (name.startswith("batch.") and name.endswith(".npz")):
+                continue
+            try:
+                out.append((int(name.split(".")[1]), os.path.join(pend, name)))
+            except ValueError:
+                continue
+        return sorted(out)
+
+    def _apply_batch(self, y, x, c):
+        """Route + append a validated batch into the carried dataset
+        and mark the touched subsets dirty; returns the routed subset
+        ids. Shared by live ingest and restart replay (replay must
+        not re-persist what is already on disk)."""
+        subs = self._router.route(c)
+        base = self.n_rows
+        self._y = np.concatenate([self._y, y])
+        self._x = np.concatenate([self._x, x])
+        self._coords = np.concatenate([self._coords, c])
+        for i, j in enumerate(subs):
+            j = int(j)
+            self._assignments[j] = np.concatenate(
+                [self._assignments[j], np.asarray([base + i])]
+            )
+            self._dirty.add(j)
+        return subs
+
+    def _replay_pending(self) -> int:
+        """Restart path: fold surviving batch files back in. Files at
+        or below the committed watermark already rode a published
+        generation (the commit is the durability handoff) — drop
+        them; the rest re-route against the fresh router and re-dirty
+        their subsets so the next refit folds their rows in. Returns
+        the number of batches replayed."""
+        led = self.pstats.ingest
+        replayed = 0
+        for seq, path in self._scan_pending():
+            self._pending_seq = max(self._pending_seq, seq + 1)
+            if seq <= self._watermark:
+                try:
+                    os.remove(path)
+                except OSError:  # pragma: no cover - defensive
+                    pass
+                continue
+            with np.load(path) as z:
+                y, x, c = z["y"], z["x"], z["coords"]
+            subs = self._apply_batch(y, x, c)
+            self._pending.append(
+                (seq, frozenset(int(j) for j in subs))
+            )
+            replayed += 1
+            led["replayed_batches"] += 1
+            led["replayed_rows"] += int(y.shape[0])
+            self._event(
+                "ingest_replayed", seq=seq, n_rows=int(y.shape[0]),
+                routed_subsets=sorted({int(j) for j in subs}),
+            )
+        led["ingest_watermark"] = self._watermark
+        if replayed:
+            led["dirty_subsets"] = list(self.dirty_subsets)
+        return replayed
+
+    def _advance_watermark(self) -> int:
+        """Walk the pending log in sequence order and advance the
+        watermark over the leading run of batches whose routed
+        subsets are all clean (their rows are in the splice that is
+        about to publish). Contiguity matters: a later clean batch
+        behind a still-dirty one stays pending, else a restart would
+        skip the dirty one's rows."""
+        mark = self._watermark
+        for seq, routed in sorted(self._pending):
+            if routed & self._dirty:
+                break
+            mark = max(mark, seq)
+        self._watermark = mark
+        return mark
+
+    def _drop_committed_pending(self) -> None:
+        """Delete batch files at or below the committed watermark —
+        only AFTER the generation carrying their rows has published
+        (the handoff order is what makes the log durable)."""
+        live = []
+        for seq, routed in self._pending:
+            if seq <= self._watermark:
+                try:
+                    os.remove(self._pending_path(seq))
+                except OSError:  # pragma: no cover - defensive
+                    pass
+            else:
+                live.append((seq, routed))
+        self._pending = live
 
     # -- the fit/refit executor ---------------------------------------
 
@@ -490,10 +636,25 @@ class LiveFit:
         )
         self._full_fit_wall = monotonic() - t0
         self._dirty.clear()
-        return self._publish(
-            k_pub, "fit",
-            {"n_rows": self.n_rows, "n_subsets": cfg.n_subsets},
+        # The committed watermark from the PREVIOUS lineage (if this
+        # directory already holds generations) decides which surviving
+        # batch files are replayed below; the base fit itself carries
+        # none of the pending rows, so it republishes that same mark.
+        cur = current_generation(self.gen_dir)
+        self._watermark = (
+            -1 if cur is None
+            else int(cur.get("ingest_watermark", -1))
         )
+        manifest = self._publish(
+            k_pub, "fit",
+            {
+                "n_rows": self.n_rows,
+                "n_subsets": cfg.n_subsets,
+                "ingest_watermark": self._watermark,
+            },
+        )
+        self._replay_pending()
+        return manifest
 
     def ingest(self, y_new, x_new=None, coords_new=None) -> IngestReceipt:
         """Append a batch of observations: route each row to its
@@ -508,21 +669,14 @@ class LiveFit:
         if coords_new is None:
             raise IngestError("coords_new is required")
         y, x, c = self._validate_batch(y_new, x_new, coords_new)
-        subs = self._router.route(c)
-        base = self.n_rows
-        self._y = np.concatenate([self._y, y])
-        self._x = np.concatenate([self._x, x])
-        self._coords = np.concatenate([self._coords, c])
-        for i, j in enumerate(subs):
-            j = int(j)
-            self._assignments[j] = np.concatenate(
-                [self._assignments[j], np.asarray([base + i])]
-            )
-            self._dirty.add(j)
+        subs = self._apply_batch(y, x, c)
+        seq = self._persist_batch(y, x, c)
+        self._pending.append((seq, frozenset(int(j) for j in subs)))
         groups, frac = self._group_sets(sorted(self._dirty))
         led = self.pstats.ingest
         led["ingest_batches"] += 1
         led["ingested_rows"] += int(y.shape[0])
+        led["pending_persisted"] += 1
         led["dirty_subsets"] = list(self.dirty_subsets)
         led["dirty_groups"] = list(groups)
         led["dirty_group_frac"] = round(frac, 4)
@@ -653,6 +807,8 @@ class LiveFit:
             round(speedup, 3) if speedup else None
         )
         led["dirty_subsets"] = list(self.dirty_subsets)
+        mark = self._advance_watermark()
+        led["ingest_watermark"] = mark
         manifest = self._publish(
             jax.random.fold_in(key, 0xF17), "refit",
             {
@@ -660,8 +816,10 @@ class LiveFit:
                 "reused_subsets": len(reused),
                 "full": bool(full),
                 "wall_s": round(wall, 4),
+                "ingest_watermark": mark,
             },
         )
+        self._drop_committed_pending()
         return RefitReport(
             generation=int(manifest["generation"]),
             refit_subsets=tuple(target),

@@ -278,6 +278,35 @@ class TestBucketKeys:
             model, "samp", 250, 32, None, 3906, 1, 2, 128, 2
         )
 
+    def test_engine_fields_change_digest_and_chunk_key(self):
+        """ISSUE 20 isolation pin: the subset-engine knobs trace
+        DIFFERENT programs (vecchia's packed coefficients vs the
+        dense factor; bf16 build inserts casts), so each must ride
+        both the config digest and the L1/L2 bucket key — a warm
+        dense store serving a vecchia ask would feed mismatched
+        avals straight into the executor."""
+        import dataclasses
+
+        base = SMKConfig()
+        for kw in (
+            {"subset_engine": "vecchia"},
+            {"n_neighbors": 8},
+            {"build_dtype": "bfloat16"},
+        ):
+            cfg = dataclasses.replace(base, **kw)
+            assert config_digest(cfg) != config_digest(base), kw
+        dims = ("samp", 250, 32, None, 3906, 1, 2, 64, 2)
+        kd = _chunk_key(SpatialProbitGP(base, weight=1), *dims)
+        for kw in (
+            {"subset_engine": "vecchia"},
+            {"n_neighbors": 8},
+            {"build_dtype": "bfloat16"},
+        ):
+            model = SpatialProbitGP(
+                dataclasses.replace(base, **kw), weight=1
+            )
+            assert _chunk_key(model, *dims) != kd, kw
+
     def test_store_from_config_gating(self, tmp_path):
         assert store_from_config(SMKConfig()) is None
         cfg = SMKConfig(compile_store_dir=str(tmp_path))
@@ -404,6 +433,26 @@ class TestStoreFit:
         np.testing.assert_array_equal(
             np.asarray(res.param_grid), np.asarray(res_ref.param_grid)
         )
+
+    def test_warm_dense_store_misses_on_vecchia_ask(
+        self, warm_store, problem
+    ):
+        """A store warmed with dense programs must MISS (and then
+        populate its own buckets) when the same data is fit under
+        subset_engine='vecchia' — never serve a dense executable to
+        the sparse engine."""
+        sd, _, _, _ = warm_store
+        n_before = len(os.listdir(sd))
+        ps = ChunkPipelineStats()
+        _, res = _fit(
+            _cfg(sd, subset_engine="vecchia"), problem,
+            pipeline_stats=ps,
+        )
+        assert ps.programs
+        assert all(p["source"] != "l2" for p in ps.programs)
+        # the vecchia programs landed under their own keys
+        assert len(os.listdir(sd)) > n_before
+        assert np.isfinite(np.asarray(res.param_grid)).all()
 
     def test_kill_resume_through_store(
         self, warm_store, problem, tmp_path

@@ -250,6 +250,23 @@ def test_refit_subset_bounds_typed(live_loop):
 # -- generation publication primitives --------------------------------
 
 
+def test_append_log_persists_then_consumes(live_loop):
+    """ISSUE 20 durability pin (read-only on the shared loop): the
+    fixture's ingest persisted one pending batch file; its refit
+    consumed the batch (routed subsets all clean), stamped the
+    contiguous watermark into the committed manifest, and only then
+    deleted the file — the commit is the durability handoff."""
+    live = live_loop["live"]
+    pend = os.path.join(live.gen_dir, "pending")
+    assert os.path.isdir(pend) and os.listdir(pend) == []
+    led = live.pstats.ingest
+    assert led["pending_persisted"] == 1
+    assert led["ingest_watermark"] == 0
+    assert led["replayed_batches"] == 0
+    assert current_generation(live.gen_dir)["ingest_watermark"] == 0
+    assert live._pending == []
+
+
 def test_commit_refuses_unlanded_generation(live_loop, tmp_path):
     with pytest.raises(GenerationError):
         commit_generation(str(tmp_path), 0)
@@ -461,3 +478,53 @@ def test_serve_during_swap_never_torn(live_loop, engine_gen0):
     assert len(results) == 80  # zero dropped
     for r in results:
         assert np.array_equal(r, exp0) or np.array_equal(r, exp1)
+
+
+@pytest.mark.slow
+def test_restart_replays_unrefit_rows(tmp_path):
+    """Process-death drill for the append log (ISSUE 20): rows
+    ingested but never refit must SURVIVE a restart. A new LiveFit
+    on the same gen_dir replays the surviving batch files after its
+    base fit — re-routed, re-dirtied, folded in by the next refit —
+    while files at or below the committed watermark (rows that rode
+    a published generation) are dropped, not double-applied."""
+    gd = str(tmp_path / "gens")
+    y, x, coords, ct, xt = _problem()
+
+    # life 1: fit, ingest one batch, die before refit
+    live = LiveFit(gd, config=CFG, coords_test=ct, x_test=xt)
+    live.fit(jax.random.key(0), y, x, coords)
+    yb, xb, cb = _batch_for_subset(live, 1)
+    live.ingest(yb, xb, cb)
+    pend = os.path.join(gd, "pending")
+    assert os.listdir(pend) == ["batch.00000000.npz"]
+    live.close()  # no refit: without the log these rows are gone
+
+    # life 2: same gen_dir, base fit -> replay folds the batch back
+    live2 = LiveFit(gd, config=CFG, coords_test=ct, x_test=xt)
+    live2.fit(jax.random.key(1), y, x, coords)
+    led = live2.pstats.ingest
+    assert led["replayed_batches"] == 1
+    assert led["replayed_rows"] == yb.shape[0]
+    assert live2.n_rows == N + yb.shape[0]
+    assert 1 in live2._dirty  # replay re-dirtied the routed subset
+    report = live2.refit(jax.random.key(2))
+    assert 1 in report.refit_subsets
+    assert current_generation(gd)["ingest_watermark"] == 0
+    assert os.listdir(pend) == []
+
+    # life 3: a stale file AT the watermark (crash between commit
+    # and delete) is dropped on restart, never double-applied
+    from smk_tpu.utils.checkpoint import _atomic_savez
+
+    _atomic_savez(
+        os.path.join(pend, "batch.00000000.npz"),
+        {"y": yb, "x": xb, "coords": cb},
+    )
+    live2.close()
+    live3 = LiveFit(gd, config=CFG, coords_test=ct, x_test=xt)
+    live3.fit(jax.random.key(3), y, x, coords)
+    assert live3.pstats.ingest["replayed_batches"] == 0
+    assert live3.n_rows == N
+    assert os.listdir(pend) == []
+    live3.close()

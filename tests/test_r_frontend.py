@@ -424,6 +424,47 @@ class TestAdaptiveKnobs:
             SMKConfig(target_rhat=1.0)
 
 
+class TestSubsetEngineKnobs:
+    def test_subset_engine_knobs_wired(self):
+        """The ISSUE 20 front-end additions: R ``subset.engine``
+        (match.arg over dense/vecchia, dense first = bit-identical
+        default), ``n.neighbors`` and ``build.dtype`` must exist and
+        feed the matching SMKConfig fields — source-checked like the
+        ISSUE 12/15/17/18 knob wirings, plus the config-side
+        validation the R values route through."""
+        import os
+
+        from smk_tpu.config import SMKConfig
+
+        r_src = open(
+            os.path.join(
+                os.path.dirname(os.path.dirname(__file__)),
+                "r", "meta_kriging_tpu.R",
+            )
+        ).read()
+        assert 'subset.engine = c("dense", "vecchia")' in r_src
+        assert "n.neighbors = 16L" in r_src
+        assert 'build.dtype = c("float32",' in r_src
+        assert "subset.engine <- match.arg(subset.engine)" in r_src
+        assert "build.dtype <- match.arg(build.dtype)" in r_src
+        assert "subset_engine = subset.engine" in r_src
+        assert "n_neighbors = as.integer(n.neighbors)" in r_src
+        assert "build_dtype = build.dtype" in r_src
+        # the R defaults match SMKConfig's (dense-first keeps every
+        # existing R workflow bit-identical), and the values R sends
+        # route through the config-side validation
+        cfg = SMKConfig()
+        assert cfg.subset_engine == "dense"
+        assert cfg.n_neighbors == 16
+        assert cfg.build_dtype == "float32"
+        with pytest.raises(ValueError, match="subset_engine"):
+            SMKConfig(subset_engine="nngp")
+        with pytest.raises(ValueError, match="n_neighbors"):
+            SMKConfig(n_neighbors=0)
+        with pytest.raises(ValueError, match="build_dtype"):
+            SMKConfig(build_dtype="float16")
+
+
 class TestResilienceKnobs:
     def test_watchdog_and_dist_init_args_wired(self):
         """The ISSUE 11 front-end additions: R ``watchdog`` and

@@ -1658,11 +1658,112 @@ class TestGenerationPublicationRule:
         assert "SMK119" in rules_hit(broken, path=real)
 
 
+class TestEngineDispatchRule:
+    """SMK120 (ISSUE 20): model-layer code may only reach the dense
+    subset-factor entry points of ops/chol.py through the
+    engine-dispatch seam (_chol_r / _shifted_chol_one /
+    _shifted_chol_stack).  A direct call hard-wires the dense engine
+    and, under subset_engine='vecchia', rebuilds the m^3 wall while
+    the rest of the sampler runs sparse."""
+
+    def test_direct_call_flagged(self):
+        src = (
+            "from smk_tpu.ops.chol import shifted_cholesky\n"
+            "def component_update(r0, shift):\n"
+            "    return shifted_cholesky(r0, shift)\n"
+        )
+        assert lines_hit(src, "SMK120") == [3]
+
+    def test_alias_and_attribute_spellings_flagged(self):
+        src = (
+            "from smk_tpu.ops.chol import batched_shifted_cholesky as bsc\n"
+            "def f(r, s):\n"
+            "    return bsc(r, s)\n"
+        )
+        assert "SMK120" in rules_hit(src)
+        src2 = (
+            "from smk_tpu.ops import chol\n"
+            "def f(r, s):\n"
+            "    return chol.blocked_cholesky(r, s)\n"
+        )
+        assert "SMK120" in rules_hit(src2)
+
+    def test_seam_functions_exempt(self):
+        for seam in ("_chol_r", "_shifted_chol_one", "_shifted_chol_stack"):
+            src = (
+                "from smk_tpu.ops.chol import shifted_cholesky\n"
+                f"def {seam}(self, r, s):\n"
+                "    return shifted_cholesky(r, s)\n"
+            )
+            assert "SMK120" not in rules_hit(src), seam
+
+    def test_innermost_enclosing_wins(self):
+        # nested helper INSIDE a seam function is still the seam
+        inside = (
+            "from smk_tpu.ops.chol import shifted_cholesky\n"
+            "def _outer(self, r, s):\n"
+            "    def _chol_r(rr):\n"
+            "        return shifted_cholesky(rr, s)\n"
+            "    return _chol_r(r)\n"
+        )
+        assert "SMK120" not in rules_hit(inside)
+        # seam-NAMED outer function does not bless a nested non-seam
+        # closure: innermost enclosing def decides
+        outside = (
+            "from smk_tpu.ops.chol import shifted_cholesky\n"
+            "def _chol_r(self, r, s):\n"
+            "    def helper(rr):\n"
+            "        return shifted_cholesky(rr, s)\n"
+            "    return helper(r)\n"
+        )
+        assert "SMK120" in rules_hit(outside)
+
+    def test_shared_primitive_and_other_trees_clean(self):
+        # jittered_cholesky is the shared small-block primitive both
+        # engines use — not an engine choice
+        src = (
+            "from smk_tpu.ops.chol import jittered_cholesky\n"
+            "def f(r):\n"
+            "    return jittered_cholesky(r, 1e-6)\n"
+        )
+        assert "SMK120" not in rules_hit(src)
+        # the rule only polices smk_tpu/models/
+        direct = (
+            "from smk_tpu.ops.chol import shifted_cholesky\n"
+            "def f(r, s):\n"
+            "    return shifted_cholesky(r, s)\n"
+        )
+        for path in (OPS_PATH, SCRIPT_PATH, TESTS_PATH):
+            assert "SMK120" not in rules_hit(direct, path=path), path
+
+    def test_suppression_with_justification(self):
+        src = (
+            "from smk_tpu.ops.chol import shifted_cholesky\n"
+            "def f(r, s):\n"
+            "    return shifted_cholesky(r, s)  "
+            "# smklint: disable=SMK120 -- dense arm of the engine "
+            "seam: vecchia dispatched above\n"
+        )
+        hits = rules_hit(src)
+        assert "SMK120" not in hits and "SMK100" not in hits
+
+    def test_real_probit_gp_clean_and_seeded_defect_caught(self):
+        real = "smk_tpu/models/probit_gp.py"
+        src = repo_file(real)
+        assert "SMK120" not in rules_hit(src, path=real)
+        broken = src + (
+            "\n\ndef _shortcut_factor(r0, shift):\n"
+            "    from smk_tpu.ops.chol import shifted_cholesky\n"
+            "    return shifted_cholesky(r0, shift)\n"
+        )
+        assert "SMK120" in rules_hit(broken, path=real)
+
+
 @pytest.mark.parametrize("rule_id", [
     "SMK101", "SMK102", "SMK103", "SMK104", "SMK105", "SMK106",
     "SMK107", "SMK108", "SMK109", "SMK110", "SMK111", "SMK112",
     "SMK113", "SMK114", "SMK115", "SMK116", "SMK117", "SMK118",
-    "SMK119",
+    "SMK119", "SMK120",
 ])
 def test_every_rule_documented_in_catalogue(rule_id):
     from smk_tpu.analysis.lint import _list_rules
